@@ -1,0 +1,13 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU mesh BEFORE jax initializes, so
+multi-chip sharding paths (parallel/, olap/tpu/) are exercised without TPU
+hardware — the same trick the driver's dryrun uses.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

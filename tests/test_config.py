@@ -1,0 +1,116 @@
+"""Config-system tests (semantics modeled on the reference's
+GraphDatabaseConfigurationTest / ConfigOption behaviors)."""
+
+import pytest
+
+from titan_tpu.config import (Configuration, MapConfiguration, MergedConfiguration,
+                              ModifiableConfiguration, Mutability, Restriction)
+from titan_tpu.config.options import ConfigNamespace, ConfigOption, positive
+from titan_tpu.config import defaults
+
+
+def make_tree():
+    root = ConfigNamespace(None, "root")
+    ns = ConfigNamespace(root, "storage")
+    opt_str = ConfigOption(ns, "backend", "", str, None, Mutability.LOCAL)
+    opt_int = ConfigOption(ns, "buffer-size", "", int, 1024, Mutability.MASKABLE, positive)
+    opt_bool = ConfigOption(ns, "read-only", "", bool, False, Mutability.LOCAL)
+    fixed = ConfigOption(ns, "cluster-init", "", int, 8, Mutability.FIXED)
+    umb = ConfigNamespace(root, "index", umbrella=True)
+    umb_opt = ConfigOption(umb, "backend", "", str, "memindex", Mutability.GLOBAL_OFFLINE)
+    return root, opt_str, opt_int, opt_bool, fixed, umb, umb_opt
+
+
+def test_paths_and_umbrella():
+    root, opt_str, *_, umb, umb_opt = make_tree()
+    assert opt_str.path() == "storage.backend"
+    assert umb_opt.path("search") == "index.search.backend"
+    with pytest.raises(ValueError):
+        umb_opt.path()  # missing umbrella element
+    with pytest.raises(ValueError):
+        opt_str.path("extra")
+
+
+def test_typed_get_coercion_and_defaults():
+    root, opt_str, opt_int, opt_bool, *_ = make_tree()
+    raw = MapConfiguration({"storage.backend": "inmemory",
+                            "storage.buffer-size": "2048",
+                            "storage.read-only": "true"})
+    cfg = Configuration(root, raw)
+    assert cfg.get(opt_str) == "inmemory"
+    assert cfg.get(opt_int) == 2048  # string coerced
+    assert cfg.get(opt_bool) is True
+    empty = Configuration(root, MapConfiguration())
+    assert empty.get(opt_int) == 1024  # default
+    assert empty.get(opt_str) is None
+
+
+def test_verification():
+    root, _, opt_int, *_ = make_tree()
+    cfg = Configuration(root, MapConfiguration({"storage.buffer-size": "-1"}))
+    with pytest.raises(ValueError):
+        cfg.get(opt_int)
+
+
+def test_mutability_enforcement_on_set():
+    root, opt_str, opt_int, opt_bool, fixed, umb, umb_opt = make_tree()
+    raw = MapConfiguration()
+    mod = ModifiableConfiguration(root, raw, Restriction.GLOBAL)
+    with pytest.raises(ValueError):
+        mod.set(opt_str, "x")  # LOCAL option not settable in GLOBAL view
+    with pytest.raises(ValueError):
+        mod.set(fixed, 4)  # FIXED refuses online change
+    mod.set(fixed, 4, force=True)  # cluster initialization path
+    assert mod.get(fixed) == 4
+    with pytest.raises(ValueError):
+        mod.set(umb_opt, "es", "search")  # GLOBAL_OFFLINE online
+    mod.set(umb_opt, "es", "search", force=True)
+    assert mod.get(umb_opt, "search") == "es"
+
+
+def test_merged_masking_semantics():
+    root, opt_str, opt_int, opt_bool, fixed, umb, umb_opt = make_tree()
+    local = Configuration(root, MapConfiguration({
+        "storage.backend": "inmemory",      # LOCAL: local wins
+        "storage.buffer-size": 10,          # MASKABLE: local masks global
+        "storage.cluster-init": 99,         # FIXED: global must win
+    }))
+    glob = Configuration(root, MapConfiguration({
+        "storage.buffer-size": 20,
+        "storage.cluster-init": 8,
+    }))
+    merged = MergedConfiguration(local, glob)
+    assert merged.get(opt_str) == "inmemory"
+    assert merged.get(opt_int) == 10
+    assert merged.get(fixed) == 8  # FIXED comes from global store
+
+
+def test_umbrella_container_discovery():
+    root, *_, umb, umb_opt = make_tree()
+    cfg = Configuration(root, MapConfiguration({
+        "index.search.backend": "memindex",
+        "index.geo.backend": "memindex",
+    }))
+    assert cfg.container_names(umb) == ["geo", "search"]
+
+
+def test_resolve_option_roundtrip():
+    root, opt_str, *_, umb, umb_opt = make_tree()
+    cfg = Configuration(root, MapConfiguration())
+    opt, fills = cfg.resolve_option("storage.backend")
+    assert opt is opt_str and fills == []
+    opt, fills = cfg.resolve_option("index.search.backend")
+    assert opt is umb_opt and fills == ["search"]
+    with pytest.raises(KeyError):
+        cfg.resolve_option("storage.nope")
+    with pytest.raises(KeyError):
+        cfg.resolve_option("storage")
+
+
+def test_default_tree_is_wellformed():
+    # every declared default passes its own verifier; spot-check paths
+    assert defaults.STORAGE_BACKEND.path() == "storage.backend"
+    assert defaults.INDEX_BACKEND.path("search") == "index.search.backend"
+    assert defaults.MAX_PARTITIONS.validate(64) == 64
+    with pytest.raises(ValueError):
+        defaults.MAX_PARTITIONS.validate(48)  # not a power of two

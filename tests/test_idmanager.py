@@ -1,0 +1,116 @@
+"""ID bit-packing tests (semantics modeled on the reference's IDManagementTest)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from titan_tpu.errors import InvalidIDError
+from titan_tpu.ids import IDManager, IDType
+
+
+@pytest.fixture(params=[0, 1, 5, 10])
+def idm(request):
+    return IDManager(partition_bits=request.param)
+
+
+def test_vertex_roundtrip(idm):
+    rng = random.Random(1)
+    for _ in range(1000):
+        count = rng.randint(1, idm.max_count)
+        partition = rng.randrange(idm.num_partitions)
+        for t in (IDType.NORMAL_VERTEX, IDType.PARTITIONED_VERTEX,
+                  IDType.UNMODIFIABLE_VERTEX):
+            eid = idm.vertex_id(count, partition, t)
+            assert eid > 0
+            assert idm.count(eid) == count
+            assert idm.partition(eid) == partition
+            assert idm.id_type(eid) is t
+            assert idm.is_user_vertex_id(eid)
+            assert not idm.is_schema_id(eid)
+
+
+def test_schema_ids(idm):
+    for t in (IDType.USER_PROPERTY_KEY, IDType.SYSTEM_PROPERTY_KEY,
+              IDType.USER_EDGE_LABEL, IDType.SYSTEM_EDGE_LABEL,
+              IDType.VERTEX_LABEL, IDType.GENERIC_SCHEMA):
+        eid = idm.schema_id(t, 42)
+        assert idm.is_schema_id(eid)
+        assert not idm.is_user_vertex_id(eid)
+        assert idm.partition(eid) == 0
+        assert idm.count(eid) == 42
+        assert idm.id_type(eid) is t
+    with pytest.raises(InvalidIDError):
+        idm.schema_id(IDType.NORMAL_VERTEX, 1)
+
+
+def test_bounds(idm):
+    with pytest.raises(InvalidIDError):
+        idm.vertex_id(0, 0)  # count must be positive
+    with pytest.raises(InvalidIDError):
+        idm.vertex_id(idm.max_count + 1, 0)
+    with pytest.raises(InvalidIDError):
+        idm.vertex_id(1, idm.num_partitions)
+    # relation ids: bare counters
+    assert idm.relation_id(1) == 1
+    with pytest.raises(InvalidIDError):
+        idm.relation_id(0)
+
+
+def test_key_mapping_roundtrip(idm):
+    rng = random.Random(2)
+    for _ in range(1000):
+        eid = idm.vertex_id(rng.randint(1, idm.max_count),
+                            rng.randrange(idm.num_partitions))
+        key = idm.key_of(eid)
+        assert idm.id_of_key(key) == eid
+        assert idm.id_of_key_bytes(idm.key_bytes(eid)) == eid
+
+
+def test_key_ordering_groups_partitions():
+    idm = IDManager(partition_bits=4)
+    rng = random.Random(3)
+    ids = [idm.vertex_id(rng.randint(1, 1 << 30), rng.randrange(16))
+           for _ in range(500)]
+    keyed = sorted(ids, key=idm.key_bytes)
+    partitions = [idm.partition(e) for e in keyed]
+    assert partitions == sorted(partitions)  # contiguous partition runs
+
+
+def test_partition_key_range():
+    idm = IDManager(partition_bits=3)
+    for p in range(8):
+        lo, hi = idm.partition_key_range(p)
+        for _ in range(50):
+            eid = idm.vertex_id(random.randint(1, idm.max_count), p)
+            assert lo <= idm.key_bytes(eid) < hi
+
+
+def test_partitioned_vertex_representatives():
+    idm = IDManager(partition_bits=3)
+    eid = idm.partitioned_vertex_id(77, 2)
+    reps = idm.partitioned_vertex_representatives(eid)
+    assert len(reps) == 8
+    assert len(set(reps)) == 8
+    assert all(idm.count(r) == 77 for r in reps)
+    assert sorted(idm.partition(r) for r in reps) == list(range(8))
+    canon = idm.canonical_vertex_id(eid)
+    assert canon in reps
+    # canonical is stable across representatives
+    assert all(idm.canonical_vertex_id(r) == canon for r in reps)
+    # ordinary vertices are their own canonical
+    v = idm.vertex_id(5, 3)
+    assert idm.canonical_vertex_id(v) == v
+    with pytest.raises(InvalidIDError):
+        idm.partitioned_vertex_representatives(v)
+
+
+def test_vectorized_matches_scalar():
+    idm = IDManager(partition_bits=6)
+    rng = random.Random(5)
+    ids = np.array([idm.vertex_id(rng.randint(1, 1 << 40), rng.randrange(64))
+                    for _ in range(2000)], dtype=np.int64)
+    assert (idm.partitions_np(ids) == [idm.partition(int(e)) for e in ids]).all()
+    assert (idm.counts_np(ids) == [idm.count(int(e)) for e in ids]).all()
+    assert (idm.types_np(ids) == [int(idm.id_type(int(e))) for e in ids]).all()
+    assert (idm.keys_np(ids) == [idm.key_of(int(e)) for e in ids]).all()

@@ -1,0 +1,118 @@
+"""Varint codec tests (semantics modeled on the reference's VariableLongTest)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from titan_tpu.utils import varint
+
+
+EDGE_VALUES = [0, 1, 2, 63, 64, 127, 128, 129, (1 << 14) - 1, 1 << 14,
+               (1 << 21) - 1, 1 << 21, (1 << 42), (1 << 63) - 1]
+
+
+def test_positive_roundtrip():
+    rng = random.Random(7)
+    values = EDGE_VALUES + [rng.getrandbits(rng.randint(1, 63)) for _ in range(500)]
+    buf = bytearray()
+    spans = []
+    for v in values:
+        start = len(buf)
+        varint.write_positive(buf, v)
+        spans.append((v, start, len(buf)))
+        assert len(buf) - start == varint.positive_length(v)
+    for v, start, end in spans:
+        got, pos = varint.read_positive(buf, start)
+        assert got == v and pos == end
+
+
+def test_positive_rejects_negative():
+    with pytest.raises(ValueError):
+        varint.write_positive(bytearray(), -1)
+
+
+def test_order_preserving_within_length():
+    # equal-length encodings must compare byte-wise like their values
+    rng = random.Random(3)
+    for _ in range(200):
+        bits = rng.randint(1, 62)
+        a = rng.getrandbits(bits)
+        b = rng.getrandbits(bits)
+        ba, bb = bytearray(), bytearray()
+        varint.write_positive(ba, a)
+        varint.write_positive(bb, b)
+        if len(ba) == len(bb):
+            assert (bytes(ba) < bytes(bb)) == (a < b)
+
+
+def test_signed_roundtrip():
+    rng = random.Random(11)
+    values = [0, -1, 1, -(1 << 62), (1 << 62)] + \
+             [rng.getrandbits(62) * (1 if rng.random() < .5 else -1) for _ in range(300)]
+    for v in values:
+        buf = bytearray()
+        varint.write_signed(buf, v)
+        got, pos = varint.read_signed(buf, 0)
+        assert got == v and pos == len(buf)
+
+
+def test_backward_roundtrip():
+    rng = random.Random(13)
+    values = EDGE_VALUES + [rng.getrandbits(rng.randint(1, 63)) for _ in range(300)]
+    buf = bytearray()
+    spans = []
+    for v in values:
+        start = len(buf)
+        varint.write_positive_backward(buf, v)
+        spans.append((v, start, len(buf)))
+    # read each value backwards from its end offset
+    for v, start, end in spans:
+        got, s = varint.read_positive_backward(buf, end)
+        assert got == v and s == start
+    # signed backward
+    for v in [-5, 5, 0, -(1 << 40), 1 << 40]:
+        b = bytearray()
+        varint.write_signed_backward(b, v)
+        got, s = varint.read_signed_backward(b, len(b))
+        assert got == v and s == 0
+
+
+def test_prefixed_roundtrip():
+    rng = random.Random(17)
+    for _ in range(400):
+        pbits = rng.randint(1, 6)
+        prefix = rng.getrandbits(pbits)
+        value = rng.getrandbits(rng.randint(1, 50))
+        buf = bytearray()
+        varint.write_positive_with_prefix(buf, value, prefix, pbits)
+        got_v, got_p, pos = varint.read_positive_with_prefix(buf, 0, pbits)
+        assert (got_v, got_p, pos) == (value, prefix, len(buf))
+
+
+def test_prefixed_order_within_prefix():
+    # same prefix, equal length ⇒ byte order == value order
+    rng = random.Random(19)
+    for _ in range(200):
+        bits = rng.randint(1, 40)
+        a, b = rng.getrandbits(bits), rng.getrandbits(bits)
+        ba, bb = bytearray(), bytearray()
+        varint.write_positive_with_prefix(ba, a, 2, 3)
+        varint.write_positive_with_prefix(bb, b, 2, 3)
+        if len(ba) == len(bb):
+            assert (bytes(ba) < bytes(bb)) == (a < b)
+
+
+def test_bulk_read_matches_scalar():
+    rng = random.Random(23)
+    values = [rng.getrandbits(rng.randint(1, 62)) for _ in range(2000)]
+    buf = bytearray()
+    offsets = []
+    for v in values:
+        offsets.append(len(buf))
+        varint.write_positive(buf, v)
+    data = np.frombuffer(bytes(buf), dtype=np.uint8)
+    got, ends = varint.bulk_read_positive(data, np.array(offsets))
+    assert got.tolist() == values
+    expected_ends = offsets[1:] + [len(buf)]
+    assert ends.tolist() == expected_ends

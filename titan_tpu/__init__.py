@@ -1,0 +1,29 @@
+"""titan_tpu — a TPU-native distributed transactional property-graph framework.
+
+Capability surface modeled on the reference graph database surveyed in
+SURVEY.md (Titan 1.0): OLTP property graph with schema + composite/mixed
+indexes over a pluggable BigTable-style storage SPI, Gremlin-style traversal,
+and an OLAP vertex-program engine that executes frontier supersteps as batched
+JAX gather/segment-reduce kernels over a chip-sharded CSR snapshot of the edge
+store (``titan_tpu.olap.tpu``).
+
+Entry point parity with the reference's ``TitanFactory.open``
+(reference: titan-core core/TitanFactory.java:42):
+
+    import titan_tpu
+    g = titan_tpu.open("inmemory")              # shorthand
+    g = titan_tpu.open({"storage.backend": "inmemory"})
+"""
+
+__version__ = "0.1.0"
+
+from titan_tpu import errors
+
+
+def open(config):  # noqa: A001  (deliberate builtin shadow, package-level)
+    """Open a graph (lazy import keeps the core importable without JAX)."""
+    from titan_tpu.factory import open_graph
+    return open_graph(config)
+
+
+__all__ = ["open", "errors", "__version__"]

@@ -1,0 +1,15 @@
+from titan_tpu.config.options import (ConfigElement, ConfigNamespace, ConfigOption,
+                                      Mutability, SEPARATOR)
+from titan_tpu.config.configuration import (Configuration, MapConfiguration,
+                                            MergedConfiguration,
+                                            ModifiableConfiguration,
+                                            ReadConfiguration, Restriction,
+                                            WriteConfiguration)
+from titan_tpu.config import defaults
+
+__all__ = [
+    "ConfigElement", "ConfigNamespace", "ConfigOption", "Mutability", "SEPARATOR",
+    "Configuration", "MapConfiguration", "MergedConfiguration",
+    "ModifiableConfiguration", "ReadConfiguration", "Restriction",
+    "WriteConfiguration", "defaults",
+]

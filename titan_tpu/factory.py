@@ -1,0 +1,15 @@
+"""Graph factory — the ``titan_tpu.open`` entry point.
+
+Counterpart of the reference's TitanFactory (reference: titan-core
+core/TitanFactory.java:42,62-130): accepts a backend shorthand
+(``"inmemory"``, ``"sqlite:/path"``), a dotted-path dict, or a typed
+Configuration, and opens a StandardGraph.
+"""
+
+from __future__ import annotations
+
+
+def open_graph(config):
+    raise NotImplementedError(
+        "the graph engine is not wired up yet; this stub will be replaced "
+        "when titan_tpu.core lands")

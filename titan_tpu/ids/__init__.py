@@ -1,0 +1,3 @@
+from titan_tpu.ids.idmanager import IDManager, IDType, TYPE_BITS, TYPE_MASK
+
+__all__ = ["IDManager", "IDType", "TYPE_BITS", "TYPE_MASK"]
