@@ -1,0 +1,127 @@
+"""BufferedMutator / backend_op / cache tests (reference semantics:
+CacheTransaction buffering, BackendOperation retries, ExpirationKCVSCache)."""
+
+import pytest
+
+from titan_tpu.errors import PermanentBackendError, TemporaryBackendError
+from titan_tpu.storage import Entry, KeySliceQuery, SliceQuery
+from titan_tpu.storage.cache import ExpirationStoreCache
+from titan_tpu.storage.inmemory import InMemoryStoreManager
+from titan_tpu.storage.tx import BackendTransaction, BufferedMutator, backend_op
+
+
+def k(i):
+    return i.to_bytes(8, "big")
+
+
+def c(i):
+    return i.to_bytes(4, "big")
+
+
+def test_backend_op_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TemporaryBackendError("try again")
+        return "ok"
+
+    assert backend_op(flaky, attempts=5, wait_ms=1) == "ok"
+    assert len(calls) == 3
+
+
+def test_backend_op_exhausts_attempts():
+    def always():
+        raise TemporaryBackendError("nope")
+
+    with pytest.raises(TemporaryBackendError):
+        backend_op(always, attempts=2, wait_ms=1)
+
+
+def test_backend_op_permanent_escalates_immediately():
+    calls = []
+
+    def perm():
+        calls.append(1)
+        raise PermanentBackendError("fatal")
+
+    with pytest.raises(PermanentBackendError):
+        backend_op(perm, attempts=5, wait_ms=1)
+    assert len(calls) == 1
+
+
+def test_buffered_mutator_flush_threshold():
+    m = InMemoryStoreManager()
+    t = m.begin_transaction()
+    mut = BufferedMutator(m, t, buffer_size=10, wait_ms=1)
+    store = m.open_database("edgestore")
+    for i in range(9):
+        mut.mutate("edgestore", k(i), [Entry(c(0), b"v")])
+    # below threshold: nothing flushed yet
+    assert store.get_slice(KeySliceQuery(k(0), SliceQuery()), t) == []
+    mut.mutate("edgestore", k(9), [Entry(c(0), b"v")])
+    # threshold hit: auto-flush
+    assert store.get_slice(KeySliceQuery(k(0), SliceQuery()), t) == [Entry(c(0), b"v")]
+    assert not mut.has_pending
+
+
+def test_mutation_consolidation_last_write_wins():
+    m = InMemoryStoreManager()
+    t = m.begin_transaction()
+    mut = BufferedMutator(m, t, buffer_size=100, wait_ms=1)
+    mut.mutate("edgestore", k(1), [Entry(c(1), b"old")])
+    mut.mutate("edgestore", k(1), [], [c(1)])          # delete...
+    mut.mutate("edgestore", k(1), [Entry(c(1), b"new")])  # ...then re-add
+    mut.flush()
+    store = m.open_database("edgestore")
+    assert store.get_slice(KeySliceQuery(k(1), SliceQuery()), t) == \
+        [Entry(c(1), b"new")]
+
+
+def test_backend_transaction_end_to_end():
+    m = InMemoryStoreManager()
+    edge = ExpirationStoreCache(m.open_database("edgestore"))
+    index = ExpirationStoreCache(m.open_database("graphindex"))
+    bt = BackendTransaction(m.begin_transaction(), m, edge, index,
+                            buffer_size=1000, wait_ms=1)
+    bt.mutate_edges(k(1), [Entry(c(1), b"e")])
+    bt.mutate_index(k(2), [Entry(c(2), b"i")])
+    bt.commit()
+    bt2 = BackendTransaction(m.begin_transaction(), m, edge, index, wait_ms=1)
+    assert bt2.edge_store_query(KeySliceQuery(k(1), SliceQuery())) == \
+        [Entry(c(1), b"e")]
+    assert bt2.index_query(KeySliceQuery(k(2), SliceQuery())) == \
+        [Entry(c(2), b"i")]
+    multi = bt2.edge_store_multi_query([k(1), k(9)], SliceQuery())
+    assert multi[k(1)] == [Entry(c(1), b"e")] and multi[k(9)] == []
+
+
+def test_expiration_cache_hits_and_invalidation():
+    m = InMemoryStoreManager()
+    raw = m.open_database("edgestore")
+    t = m.begin_transaction()
+    raw.mutate(k(1), [Entry(c(1), b"v1")], [], t)
+    cache = ExpirationStoreCache(raw, expire_ms=60_000, clean_wait_ms=0)
+    q = KeySliceQuery(k(1), SliceQuery())
+    assert cache.get_slice(q, t) == [Entry(c(1), b"v1")]
+    assert cache.get_slice(q, t) == [Entry(c(1), b"v1")]
+    assert cache.hits == 1 and cache.misses == 1
+    # write around the cache, then invalidate: next read sees new value
+    raw.mutate(k(1), [Entry(c(1), b"v2")], [], t)
+    cache.invalidate(k(1))
+    assert cache.get_slice(q, t) == [Entry(c(1), b"v2")]
+
+
+def test_cache_invalidation_via_backend_tx_commit():
+    m = InMemoryStoreManager()
+    edge = ExpirationStoreCache(m.open_database("edgestore"),
+                                expire_ms=60_000, clean_wait_ms=0)
+    index = ExpirationStoreCache(m.open_database("graphindex"))
+    bt = BackendTransaction(m.begin_transaction(), m, edge, index, wait_ms=1)
+    q = KeySliceQuery(k(1), SliceQuery())
+    assert bt.edge_store_query(q) == []          # caches empty result
+    bt.mutate_edges(k(1), [Entry(c(1), b"v")])
+    bt.commit()                                   # flush invalidates key
+    bt2 = BackendTransaction(m.begin_transaction(), m, edge, index, wait_ms=1)
+    assert bt2.edge_store_query(q) == [Entry(c(1), b"v")]

@@ -1,0 +1,206 @@
+"""Backend-parameterized KCVS contract suite.
+
+Modeled on the reference's shared SPI suites (titan-test
+KeyColumnValueStoreTest / MultiWriteKeyColumnValueStoreTest): the same
+assertions run against every registered backend, which is how new adapters
+prove conformance.
+"""
+
+import random
+
+import pytest
+
+from titan_tpu.storage import (Entry, KCVMutation, KeyRangeQuery, KeySliceQuery,
+                               SliceQuery)
+from titan_tpu.storage.inmemory import InMemoryStoreManager
+from titan_tpu.storage.sqlitekv import SqliteStoreManager
+
+
+@pytest.fixture(params=["inmemory", "sqlite-mem", "sqlite-file"])
+def manager(request, tmp_path):
+    if request.param == "inmemory":
+        m = InMemoryStoreManager()
+    elif request.param == "sqlite-mem":
+        m = SqliteStoreManager(None)
+    else:
+        m = SqliteStoreManager(str(tmp_path / "db"))
+    yield m
+    m.close()
+
+
+def k(i: int) -> bytes:
+    return i.to_bytes(8, "big")
+
+
+def c(i: int) -> bytes:
+    return i.to_bytes(4, "big")
+
+
+def tx(manager):
+    return manager.begin_transaction()
+
+
+def test_roundtrip_and_slice_semantics(manager):
+    store = manager.open_database("edgestore")
+    t = tx(manager)
+    store.mutate(k(1), [Entry(c(j), b"v%d" % j) for j in range(10)], [], t)
+    t.commit()
+    t = tx(manager)
+    # full row
+    full = store.get_slice(KeySliceQuery(k(1), SliceQuery()), t)
+    assert [e.column for e in full] == [c(j) for j in range(10)]
+    # interval [3, 7)
+    part = store.get_slice(KeySliceQuery(k(1), SliceQuery(c(3), c(7))), t)
+    assert [e.column for e in part] == [c(3), c(4), c(5), c(6)]
+    # limit
+    lim = store.get_slice(KeySliceQuery(k(1), SliceQuery(c(3), c(7), limit=2)), t)
+    assert [e.column for e in lim] == [c(3), c(4)]
+    # start inclusive, end exclusive
+    edge = store.get_slice(KeySliceQuery(k(1), SliceQuery(c(9), None)), t)
+    assert [e.column for e in edge] == [c(9)]
+    # missing key
+    assert store.get_slice(KeySliceQuery(k(99), SliceQuery()), t) == []
+    t.commit()
+
+
+def test_overwrite_and_delete(manager):
+    store = manager.open_database("edgestore")
+    t = tx(manager)
+    store.mutate(k(5), [Entry(c(1), b"a"), Entry(c(2), b"b")], [], t)
+    t.commit()
+    t = tx(manager)
+    store.mutate(k(5), [Entry(c(1), b"a2")], [c(2)], t)
+    t.commit()
+    t = tx(manager)
+    got = store.get_slice(KeySliceQuery(k(5), SliceQuery()), t)
+    assert got == [Entry(c(1), b"a2")]
+    t.commit()
+
+
+def test_multi_key_slice(manager):
+    store = manager.open_database("edgestore")
+    t = tx(manager)
+    for i in range(20):
+        store.mutate(k(i), [Entry(c(j), b"x") for j in range(5)], [], t)
+    t.commit()
+    t = tx(manager)
+    keys = [k(i) for i in (3, 7, 11, 99)]
+    result = store.get_slice_multi(keys, SliceQuery(c(1), c(4)), t)
+    assert set(result.keys()) == set(keys)
+    assert [e.column for e in result[k(3)]] == [c(1), c(2), c(3)]
+    assert result[k(99)] == []
+    t.commit()
+
+
+def test_ordered_key_scan(manager):
+    store = manager.open_database("edgestore")
+    t = tx(manager)
+    ids = random.Random(1).sample(range(1000), 50)
+    for i in ids:
+        store.mutate(k(i), [Entry(c(0), b"v")], [], t)
+    t.commit()
+    t = tx(manager)
+    seen = [key for key, _ in store.get_keys(
+        KeyRangeQuery(k(0), k(1000), SliceQuery()), t)]
+    assert seen == sorted(k(i) for i in ids)
+    # sub-range
+    lo, hi = k(200), k(700)
+    sub = [key for key, _ in store.get_keys(KeyRangeQuery(lo, hi, SliceQuery()), t)]
+    assert sub == [key for key in seen if lo <= key < hi]
+    t.commit()
+
+
+def test_unordered_scan_sees_all(manager):
+    store = manager.open_database("edgestore")
+    t = tx(manager)
+    for i in range(30):
+        store.mutate(k(i), [Entry(c(i % 3), b"v")], [], t)
+    t.commit()
+    t = tx(manager)
+    rows = dict(store.get_keys(SliceQuery(), t))
+    assert len(rows) == 30
+    # slice filter applies during scan: only columns in [c(1), c(3))
+    rows = dict(store.get_keys(SliceQuery(c(1), c(3)), t))
+    assert len(rows) == 20  # keys with i%3 in (1,2)
+    t.commit()
+
+
+def test_mutate_many_batch(manager):
+    muts = {
+        "edgestore": {k(1): KCVMutation([Entry(c(1), b"a")], []),
+                      k(2): KCVMutation([Entry(c(2), b"b")], [])},
+        "graphindex": {k(3): KCVMutation([Entry(c(3), b"c")], [])},
+    }
+    t = tx(manager)
+    manager.mutate_many(muts, t)
+    t.commit()
+    t = tx(manager)
+    assert manager.open_database("edgestore").get_slice(
+        KeySliceQuery(k(1), SliceQuery()), t) == [Entry(c(1), b"a")]
+    assert manager.open_database("graphindex").get_slice(
+        KeySliceQuery(k(3), SliceQuery()), t) == [Entry(c(3), b"c")]
+    t.commit()
+
+
+def test_row_deletion_removes_key_from_scan(manager):
+    store = manager.open_database("edgestore")
+    t = tx(manager)
+    store.mutate(k(1), [Entry(c(1), b"a")], [], t)
+    store.mutate(k(2), [Entry(c(1), b"a")], [], t)
+    t.commit()
+    t = tx(manager)
+    store.mutate(k(1), [], [c(1)], t)
+    t.commit()
+    t = tx(manager)
+    keys = [key for key, _ in store.get_keys(
+        KeyRangeQuery(k(0), k(100), SliceQuery()), t)]
+    assert keys == [k(2)]
+    t.commit()
+
+
+def test_clear_storage(manager):
+    store = manager.open_database("edgestore")
+    t = tx(manager)
+    store.mutate(k(1), [Entry(c(1), b"a")], [], t)
+    t.commit()
+    assert manager.exists()
+    manager.clear_storage()
+    store = manager.open_database("edgestore")
+    t = tx(manager)
+    assert store.get_slice(KeySliceQuery(k(1), SliceQuery()), t) == []
+    t.commit()
+
+
+def test_features_declared(manager):
+    f = manager.features
+    assert f.ordered_scan and f.unordered_scan and f.key_ordered
+
+
+class TestSqliteTransactionality:
+    def test_rollback_discards(self, tmp_path):
+        m = SqliteStoreManager(str(tmp_path / "db"))
+        store = m.open_database("edgestore")
+        t = m.begin_transaction()
+        store.mutate(k(1), [Entry(c(1), b"a")], [], t)
+        t.rollback()
+        t2 = m.begin_transaction()
+        assert store.get_slice(KeySliceQuery(k(1), SliceQuery()), t2) == []
+        t2.commit()
+        m.close()
+
+    def test_persistence_across_reopen(self, tmp_path):
+        path = str(tmp_path / "db")
+        m = SqliteStoreManager(path)
+        store = m.open_database("edgestore")
+        t = m.begin_transaction()
+        store.mutate(k(1), [Entry(c(1), b"persisted")], [], t)
+        t.commit()
+        m.close()
+        m2 = SqliteStoreManager(path)
+        assert m2.exists()
+        store2 = m2.open_database("edgestore")
+        t = m2.begin_transaction()
+        assert store2.get_slice(KeySliceQuery(k(1), SliceQuery()), t) == \
+            [Entry(c(1), b"persisted")]
+        t.commit()
+        m2.close()

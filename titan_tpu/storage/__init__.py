@@ -1,0 +1,9 @@
+from titan_tpu.storage.api import (Entry, EntryList, KCVMutation, KeyColumnValueStore,
+                                   KeyColumnValueStoreManager, KeyRangeQuery,
+                                   KeySliceQuery, Order, SliceQuery, StoreFeatures,
+                                   StoreTransaction, TransactionHandleConfig)
+
+__all__ = ["Entry", "EntryList", "KCVMutation", "KeyColumnValueStore",
+           "KeyColumnValueStoreManager", "KeyRangeQuery", "KeySliceQuery",
+           "Order", "SliceQuery", "StoreFeatures", "StoreTransaction",
+           "TransactionHandleConfig"]

@@ -1,0 +1,173 @@
+"""In-process KCVS backend — the bootstrap/test backend.
+
+Counterpart of the reference's in-memory store (reference: titan-core
+diskstorage/keycolumnvalue/inmemory/InMemoryStoreManager.java:37-44,
+InMemoryKeyColumnValueStore.java, ColumnValueStore.java): full ordered AND
+unordered scan support so every upper layer — including OLAP snapshots and
+partitioned-vertex handling — runs without an external cluster.
+
+Each row is a pair of parallel sorted lists (columns, values) maintained with
+bisect; rows live in a dict with a sorted-key view rebuilt lazily for ordered
+scans. One RW-ish lock per store (coarse; this backend optimizes for
+simplicity and test determinism, not contention).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Iterator, Optional, Sequence
+
+from titan_tpu.storage.api import (Entry, EntryList, KCVMutation, KeyColumnValueStore,
+                                   KeyColumnValueStoreManager, KeyRangeQuery,
+                                   KeySliceQuery, SliceQuery, StoreFeatures,
+                                   StoreTransaction, TransactionHandleConfig,
+                                   apply_slice)
+
+
+class _Row:
+    __slots__ = ("columns", "values")
+
+    def __init__(self):
+        self.columns: list[bytes] = []
+        self.values: list[bytes] = []
+
+    def mutate(self, additions: Sequence[Entry], deletions: Sequence[bytes]):
+        for col in deletions:
+            i = bisect.bisect_left(self.columns, col)
+            if i < len(self.columns) and self.columns[i] == col:
+                del self.columns[i]
+                del self.values[i]
+        for col, val in additions:
+            i = bisect.bisect_left(self.columns, col)
+            if i < len(self.columns) and self.columns[i] == col:
+                self.values[i] = val
+            else:
+                self.columns.insert(i, col)
+                self.values.insert(i, val)
+
+    def slice(self, q: SliceQuery) -> EntryList:
+        lo = bisect.bisect_left(self.columns, q.start)
+        hi = bisect.bisect_left(self.columns, q.end) if q.end is not None else len(self.columns)
+        if q.limit is not None:
+            hi = min(hi, lo + q.limit)
+        return [Entry(c, v) for c, v in zip(self.columns[lo:hi], self.values[lo:hi])]
+
+    @property
+    def empty(self) -> bool:
+        return not self.columns
+
+
+class InMemoryStore(KeyColumnValueStore):
+    def __init__(self, name: str):
+        self._name = name
+        self._rows: dict[bytes, _Row] = {}
+        self._sorted_keys: Optional[list[bytes]] = None
+        self._lock = threading.RLock()
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def get_slice(self, query: KeySliceQuery, txh: StoreTransaction) -> EntryList:
+        with self._lock:
+            row = self._rows.get(query.key)
+            return row.slice(query.slice) if row is not None else []
+
+    def mutate(self, key: bytes, additions: Sequence[Entry],
+               deletions: Sequence[bytes], txh: StoreTransaction) -> None:
+        with self._lock:
+            row = self._rows.get(key)
+            if row is None:
+                if not additions:
+                    return
+                row = _Row()
+                self._rows[key] = row
+                self._sorted_keys = None
+            row.mutate(additions, deletions)
+            if row.empty:
+                del self._rows[key]
+                self._sorted_keys = None
+
+    def get_keys(self, query, txh: StoreTransaction) -> Iterator:
+        with self._lock:
+            if self._sorted_keys is None:
+                self._sorted_keys = sorted(self._rows.keys())
+            keys = self._sorted_keys
+            if isinstance(query, KeyRangeQuery):
+                lo = bisect.bisect_left(keys, query.key_start)
+                hi = bisect.bisect_left(keys, query.key_end)
+                keys = keys[lo:hi]
+                key_limit = query.key_limit
+                sl = query.slice
+            else:
+                sl = query
+                key_limit = None
+                keys = list(keys)
+        yielded = 0
+        for k in keys:
+            if key_limit is not None and yielded >= key_limit:
+                return
+            with self._lock:
+                row = self._rows.get(k)
+                entries = row.slice(sl) if row is not None else []
+            if entries:  # key_limit counts rows that MATCH the slice
+                yield k, entries
+                yielded += 1
+
+    def clear(self):
+        with self._lock:
+            self._rows.clear()
+            self._sorted_keys = None
+
+    def row_count(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+
+class InMemoryStoreManager(KeyColumnValueStoreManager):
+    def __init__(self, config=None):
+        self._stores: dict[str, InMemoryStore] = {}
+        self._lock = threading.RLock()
+
+    @property
+    def name(self) -> str:
+        return "inmemory"
+
+    @property
+    def features(self) -> StoreFeatures:
+        return StoreFeatures(ordered_scan=True, unordered_scan=True,
+                             key_ordered=True, batch_mutation=True,
+                             multi_query=True, key_consistent=True,
+                             persists=False)
+
+    def open_database(self, name: str) -> InMemoryStore:
+        with self._lock:
+            store = self._stores.get(name)
+            if store is None:
+                store = InMemoryStore(name)
+                self._stores[name] = store
+            return store
+
+    def begin_transaction(self, config: Optional[TransactionHandleConfig] = None
+                          ) -> StoreTransaction:
+        return StoreTransaction(config)
+
+    def mutate_many(self, mutations: dict, txh: StoreTransaction) -> None:
+        for store_name, by_key in mutations.items():
+            store = self.open_database(store_name)
+            for key, m in by_key.items():
+                store.mutate(key, m.additions, m.deletions, txh)
+
+    def close(self) -> None:
+        pass
+
+    def clear_storage(self) -> None:
+        with self._lock:
+            for s in self._stores.values():
+                s.clear()
+            self._stores.clear()
+
+    def exists(self) -> bool:
+        with self._lock:
+            return any(s.row_count() for s in self._stores.values())

@@ -1,0 +1,368 @@
+"""SQLite-backed persistent KCVS — the embedded single-machine backend.
+
+Plays the role the reference fills with BerkeleyJE (reference:
+titan-berkeleyje/.../BerkeleyJEStoreManager.java, BerkeleyJEKeyValueStore.java,
+adapted through diskstorage/keycolumnvalue/keyvalue/
+OrderedKeyValueStoreManagerAdapter.java): an embedded, ACID, key-ordered,
+range-scannable local store. Instead of translating the KV-adapter stack we
+implement the KCVS contract directly on a relational schema —
+``(key BLOB, column BLOB, value BLOB, PRIMARY KEY(key, column))`` — which
+gives ordered key+column iteration and real transactions from sqlite's WAL.
+
+Each StoreTransaction owns its own sqlite connection (isolation =
+serializable via sqlite's locking); autocommit reads outside transactions use
+a shared connection under a lock.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Iterator, Optional, Sequence
+
+from titan_tpu.errors import PermanentBackendError, TemporaryBackendError
+from titan_tpu.storage.api import (Entry, EntryList, KeyColumnValueStore,
+                                   KeyColumnValueStoreManager, KeyRangeQuery,
+                                   KeySliceQuery, SliceQuery, StoreFeatures,
+                                   StoreTransaction, TransactionHandleConfig)
+
+_MULTI_CHUNK = 500       # keys per IN(...) statement (SQLITE_MAX_VARIABLE_NUMBER)
+_SCAN_PAGE = 4096        # rows per page when scanning via the shared connection
+
+
+def _table(name: str) -> str:
+    safe = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return f"kcvs_{safe}"
+
+
+def _wrap_sqlite_errors(fn):
+    """Map sqlite exceptions onto the backend taxonomy so backend_op's retry
+    layer actually retries transient lock/busy conditions."""
+    def inner(*a, **kw):
+        try:
+            return fn(*a, **kw)
+        except sqlite3.OperationalError as e:
+            msg = str(e).lower()
+            if "locked" in msg or "busy" in msg:
+                raise TemporaryBackendError(str(e)) from e
+            raise PermanentBackendError(str(e)) from e
+        except sqlite3.Error as e:
+            raise PermanentBackendError(str(e)) from e
+    return inner
+
+
+class SqliteTransaction(StoreTransaction):
+    def __init__(self, manager: "SqliteStoreManager",
+                 config: Optional[TransactionHandleConfig] = None):
+        super().__init__(config)
+        self._manager = manager
+        self._conn: Optional[sqlite3.Connection] = None
+        self._ensured: set[str] = set()
+        self._lock = threading.Lock()
+        self.closed = False
+
+    def connection(self) -> sqlite3.Connection:
+        with self._lock:
+            if self.closed:
+                raise PermanentBackendError("transaction already closed")
+            if self._conn is None:
+                self._conn = self._manager._new_connection()
+                self._conn.execute("BEGIN")
+            return self._conn
+
+    def ensure_table(self, table: str, create_sql: str) -> None:
+        """Transactional DDL: tables must be created through THIS connection
+        while it holds the write lock, or shared-connection DDL deadlocks."""
+        conn = self.connection()
+        if table not in self._ensured:
+            conn.execute(create_sql)
+            self._ensured.add(table)
+
+    def commit(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            if self._conn is not None:
+                try:
+                    self._conn.commit()
+                except sqlite3.OperationalError as e:
+                    raise TemporaryBackendError(str(e)) from e
+                finally:
+                    self._conn.close()
+                    self._conn = None
+
+    def rollback(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            if self._conn is not None:
+                self._conn.rollback()
+                self._conn.close()
+                self._conn = None
+
+
+class SqliteStore(KeyColumnValueStore):
+    def __init__(self, manager: "SqliteStoreManager", name: str):
+        self._manager = manager
+        self._name = name
+        self._table = _table(name)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def _create_sql(self) -> str:
+        return (f"CREATE TABLE IF NOT EXISTS {self._table} "
+                f"(k BLOB NOT NULL, c BLOB NOT NULL, v BLOB NOT NULL, "
+                f"PRIMARY KEY (k, c)) WITHOUT ROWID")
+
+    def _ensure(self, txh: StoreTransaction) -> None:
+        if isinstance(txh, SqliteTransaction):
+            txh.ensure_table(self._table, self._create_sql)
+        else:
+            self._manager._ensure_table(self._table)
+
+    @_wrap_sqlite_errors
+    def _execute(self, txh: StoreTransaction, sql: str, params=()) -> list:
+        """Run a query and fetch all rows (fetch happens under the shared-
+        connection lock so concurrent writers can't corrupt cursor state)."""
+        self._ensure(txh)
+        if isinstance(txh, SqliteTransaction):
+            return txh.connection().execute(sql, params).fetchall()
+        return self._manager._shared_execute(sql, params)
+
+    @staticmethod
+    def _bounds(prefix: str, lo: bytes, hi: Optional[bytes], params: list) -> str:
+        cond = f"{prefix} >= ?"
+        params.append(lo)
+        if hi is not None:
+            cond += f" AND {prefix} < ?"
+            params.append(hi)
+        return cond
+
+    def get_slice(self, query: KeySliceQuery, txh: StoreTransaction) -> EntryList:
+        q = query.slice
+        params: list = [query.key]
+        ccond = self._bounds("c", q.start, q.end, params)
+        sql = f"SELECT c, v FROM {self._table} WHERE k = ? AND {ccond} ORDER BY c ASC"
+        if q.limit is not None:
+            sql += " LIMIT ?"
+            params.append(q.limit)
+        rows = self._execute(txh, sql, params)
+        return [Entry(bytes(c), bytes(v)) for c, v in rows]
+
+    def get_slice_multi(self, keys: Sequence[bytes], slice_query: SliceQuery,
+                        txh: StoreTransaction) -> dict:
+        out = {k: [] for k in keys}
+        limit = slice_query.limit
+        for i in range(0, len(keys), _MULTI_CHUNK):
+            chunk = list(keys)[i:i + _MULTI_CHUNK]
+            params: list = list(chunk)
+            ccond = self._bounds("c", slice_query.start, slice_query.end, params)
+            placeholders = ",".join("?" * len(chunk))
+            sql = (f"SELECT k, c, v FROM {self._table} WHERE k IN ({placeholders}) "
+                   f"AND {ccond} ORDER BY k ASC, c ASC")
+            for k, c, v in self._execute(txh, sql, params):
+                lst = out[bytes(k)]
+                if limit is None or len(lst) < limit:
+                    lst.append(Entry(bytes(c), bytes(v)))
+        return out
+
+    @_wrap_sqlite_errors
+    def mutate(self, key: bytes, additions: Sequence[Entry],
+               deletions: Sequence[bytes], txh: StoreTransaction) -> None:
+        if self._manager.read_only:
+            raise PermanentBackendError("backend opened read-only")
+        del_sql = f"DELETE FROM {self._table} WHERE k = ? AND c = ?"
+        add_sql = f"INSERT OR REPLACE INTO {self._table}(k, c, v) VALUES (?, ?, ?)"
+        self._ensure(txh)
+        if isinstance(txh, SqliteTransaction):
+            conn = txh.connection()
+            conn.executemany(del_sql, [(key, c) for c in deletions])
+            conn.executemany(add_sql, [(key, e.column, e.value) for e in additions])
+        else:
+            self._manager._shared_executemany(
+                [(del_sql, [(key, c) for c in deletions]),
+                 (add_sql, [(key, e.column, e.value) for e in additions])])
+
+    def get_keys(self, query, txh: StoreTransaction) -> Iterator:
+        """Streaming scan: pages by (key, column) cursor position so the
+        shared connection never materializes the whole table and its lock is
+        released between pages."""
+        if isinstance(query, KeyRangeQuery):
+            key_lo, key_hi, sl = query.key_start, query.key_end, query.slice
+            key_limit = query.key_limit
+        else:
+            key_lo, key_hi, sl = b"", None, query
+            key_limit = None
+
+        after: Optional[tuple] = None  # (key, column) of last row seen
+        current_key: Optional[bytes] = None
+        entries: EntryList = []
+        yielded = 0
+        exhausted = False
+        while not exhausted:
+            params: list = []
+            kcond = self._bounds("k", key_lo, key_hi, params)
+            ccond = self._bounds("c", sl.start, sl.end, params)
+            sql = (f"SELECT k, c, v FROM {self._table} WHERE {kcond} AND {ccond}")
+            if after is not None:
+                sql += " AND (k > ? OR (k = ? AND c > ?))"
+                params.extend([after[0], after[0], after[1]])
+            sql += " ORDER BY k ASC, c ASC LIMIT ?"
+            params.append(_SCAN_PAGE)
+            rows = self._execute(txh, sql, params)
+            exhausted = len(rows) < _SCAN_PAGE
+            for k, c, v in rows:
+                k, c = bytes(k), bytes(c)
+                after = (k, c)
+                if k != current_key:
+                    if current_key is not None and entries:
+                        yield current_key, entries
+                        yielded += 1
+                        if key_limit is not None and yielded >= key_limit:
+                            return
+                    current_key = k
+                    entries = []
+                if sl.limit is None or len(entries) < sl.limit:
+                    entries.append(Entry(c, v if isinstance(v, bytes) else bytes(v)))
+        if current_key is not None and entries:
+            if key_limit is None or yielded < key_limit:
+                yield current_key, entries
+
+
+class SqliteStoreManager(KeyColumnValueStoreManager):
+    """``storage.backend=sqlite`` with ``storage.directory`` (or ``:memory:``)."""
+
+    def __init__(self, directory: Optional[str] = None, read_only: bool = False):
+        if directory is None or directory == ":memory:":
+            # sqlite shared-cache memory DBs use table-level locks that
+            # deadlock concurrent tx/shared connections; a temp file under
+            # WAL gives real MVCC and is deleted on close.
+            import tempfile
+            self._tmpdir = tempfile.mkdtemp(prefix="titan_tpu_sqlite_")
+            self._path = os.path.join(self._tmpdir, "mem.db")
+        else:
+            self._tmpdir = None
+            os.makedirs(directory, exist_ok=True)
+            self._path = os.path.join(directory, "titan_tpu.db")
+        self._uri = False
+        self.read_only = read_only
+        self._shared = self._new_connection()
+        self._shared_lock = threading.RLock()
+        self._stores: dict[str, SqliteStore] = {}
+        self._tables: set[str] = set()
+        self._closed = False
+
+    # -- connection plumbing -------------------------------------------------
+
+    def _new_connection(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self._path, uri=self._uri, timeout=30.0,
+                               check_same_thread=False, isolation_level=None)
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        return conn
+
+    def _shared_execute(self, sql: str, params=()) -> list:
+        with self._shared_lock:
+            return self._shared.execute(sql, params).fetchall()
+
+    @_wrap_sqlite_errors
+    def _shared_executemany(self, batches):
+        with self._shared_lock:
+            self._shared.execute("BEGIN")
+            try:
+                for sql, rows in batches:
+                    if rows:
+                        self._shared.executemany(sql, rows)
+                self._shared.commit()
+            except BaseException:
+                self._shared.rollback()
+                raise
+
+    def _ensure_table(self, table: str):
+        if table in self._tables:
+            return
+        with self._shared_lock:
+            self._shared.execute(
+                f"CREATE TABLE IF NOT EXISTS {table} "
+                f"(k BLOB NOT NULL, c BLOB NOT NULL, v BLOB NOT NULL, "
+                f"PRIMARY KEY (k, c)) WITHOUT ROWID")
+            self._tables.add(table)
+
+    # -- manager SPI ---------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return "sqlite"
+
+    @property
+    def features(self) -> StoreFeatures:
+        return StoreFeatures(ordered_scan=True, unordered_scan=True,
+                             key_ordered=True, transactional=True,
+                             batch_mutation=True, multi_query=True,
+                             key_consistent=True, persists=True)
+
+    def open_database(self, name: str) -> SqliteStore:
+        store = self._stores.get(name)
+        if store is None:
+            store = SqliteStore(self, name)
+            self._stores[name] = store
+        return store
+
+    def begin_transaction(self, config: Optional[TransactionHandleConfig] = None
+                          ) -> SqliteTransaction:
+        return SqliteTransaction(self, config)
+
+    def mutate_many(self, mutations: dict, txh: StoreTransaction) -> None:
+        if isinstance(txh, SqliteTransaction):
+            for store_name, by_key in mutations.items():
+                store = self.open_database(store_name)
+                for key, m in by_key.items():
+                    store.mutate(key, m.additions, m.deletions, txh)
+        else:
+            batches = []
+            for store_name, by_key in mutations.items():
+                store = self.open_database(store_name)
+                self._ensure_table(store._table)
+                del_sql = f"DELETE FROM {store._table} WHERE k = ? AND c = ?"
+                add_sql = (f"INSERT OR REPLACE INTO {store._table}(k, c, v) "
+                           f"VALUES (?, ?, ?)")
+                dels, adds = [], []
+                for key, m in by_key.items():
+                    dels.extend((key, c) for c in m.deletions)
+                    adds.extend((key, e.column, e.value) for e in m.additions)
+                batches.append((del_sql, dels))
+                batches.append((add_sql, adds))
+            self._shared_executemany(batches)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with self._shared_lock:
+            self._shared.close()
+        if self._tmpdir is not None:
+            import shutil
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
+
+    def clear_storage(self) -> None:
+        with self._shared_lock:
+            tables = [r[0] for r in self._shared.execute(
+                "SELECT name FROM sqlite_master WHERE type='table' AND "
+                "name LIKE 'kcvs_%'").fetchall()]
+            for table in tables:
+                self._shared.execute(f"DROP TABLE IF EXISTS {table}")
+            self._tables.clear()
+            self._stores.clear()
+
+    def exists(self) -> bool:
+        with self._shared_lock:
+            row = self._shared.execute(
+                "SELECT name FROM sqlite_master WHERE type='table' AND "
+                "name LIKE 'kcvs_%' LIMIT 1").fetchone()
+            return row is not None
