@@ -1,0 +1,248 @@
+"""Graph-level feature suite, backend-parameterized.
+
+Modeled on the reference's TitanGraphTest / TitanGraphBaseTest (titan-test):
+open/clopen (close+reopen to flush caches), schema, CRUD, constraint
+enforcement, tx isolation.
+"""
+
+import pytest
+
+import titan_tpu
+from titan_tpu import example
+from titan_tpu.core.defs import Cardinality, Direction, Multiplicity
+from titan_tpu.errors import SchemaViolationError
+
+
+@pytest.fixture(params=["inmemory", "sqlite"])
+def fresh_graph(request, tmp_path):
+    if request.param == "inmemory":
+        g = titan_tpu.open("inmemory")
+    else:
+        g = titan_tpu.open({"storage.backend": "sqlite",
+                            "storage.directory": str(tmp_path / "db")})
+    yield g
+    g.close()
+
+
+def test_add_and_read_vertex(fresh_graph):
+    g = fresh_graph
+    tx = g.new_transaction()
+    v = tx.add_vertex("person", name="alice", age=30)
+    vid = v.id
+    assert v.value("name") == "alice"  # read-your-writes
+    tx.commit()
+    tx2 = g.new_transaction()
+    v2 = tx2.vertex(vid)
+    assert v2 is not None
+    assert v2.value("name") == "alice" and v2.value("age") == 30
+    assert v2.label() == "person"
+    assert tx2.vertex(vid + 1234) is None
+    tx2.commit()
+
+
+def test_edges_directions_and_labels(fresh_graph):
+    g = fresh_graph
+    tx = g.new_transaction()
+    a = tx.add_vertex(name="a")
+    b = tx.add_vertex(name="b")
+    c = tx.add_vertex(name="c")
+    a.add_edge("knows", b, weight=0.5)
+    a.add_edge("knows", c)
+    b.add_edge("likes", c)
+    tx.commit()
+    tx = g.new_transaction()
+    a2 = tx.vertex(a.id)
+    assert sorted(v.value("name") for v in a2.out("knows")) == ["b", "c"]
+    assert [v.value("name") for v in tx.vertex(c.id).in_("knows")] == ["a"]
+    assert {v.value("name") for v in tx.vertex(c.id).both()} == {"a", "b"}
+    e = next(iter(a2.out_edges("knows")))
+    assert e.label() == "knows"
+    tx.commit()
+
+
+def test_single_cardinality_overwrites(fresh_graph):
+    g = fresh_graph
+    tx = g.new_transaction()
+    v = tx.add_vertex(name="x")
+    tx.commit()
+    tx = g.new_transaction()
+    v = tx.vertex(v.id)
+    v.property("name", "y")
+    assert v.value("name") == "y"
+    tx.commit()
+    tx = g.new_transaction()
+    vals = [p.value for p in tx.vertex(v.id).properties("name")]
+    assert vals == ["y"]
+    tx.commit()
+
+
+def test_set_and_list_cardinality(fresh_graph):
+    g = fresh_graph
+    mgmt = g.management()
+    mgmt.make_property_key("nick", str, Cardinality.SET)
+    mgmt.make_property_key("score", int, Cardinality.LIST)
+    tx = g.new_transaction()
+    v = tx.add_vertex()
+    v.property("nick", "bob")
+    v.property("nick", "bobby")
+    v.property("nick", "bob")       # set: duplicate ignored
+    v.property("score", 7)
+    v.property("score", 7)          # list: duplicate kept
+    tx.commit()
+    tx = g.new_transaction()
+    v = tx.vertex(v.id)
+    assert sorted(v.values("nick")) == ["bob", "bobby"]
+    assert v.values("score") == [7, 7]
+    tx.commit()
+
+
+def test_multiplicity_many2one_enforced(fresh_graph):
+    g = fresh_graph
+    g.management().make_edge_label("father", Multiplicity.MANY2ONE)
+    tx = g.new_transaction()
+    child = tx.add_vertex(name="child")
+    f1 = tx.add_vertex(name="f1")
+    f2 = tx.add_vertex(name="f2")
+    child.add_edge("father", f1)
+    with pytest.raises(SchemaViolationError):
+        child.add_edge("father", f2)
+    tx.commit()
+    # cross-tx enforcement (reads stored edges)
+    tx = g.new_transaction()
+    with pytest.raises(SchemaViolationError):
+        tx.vertex(child.id).add_edge("father", tx.vertex(f2.id))
+    tx.rollback()
+
+
+def test_multiplicity_simple_rejects_parallel(fresh_graph):
+    g = fresh_graph
+    g.management().make_edge_label("married", Multiplicity.SIMPLE)
+    tx = g.new_transaction()
+    a = tx.add_vertex()
+    b = tx.add_vertex()
+    a.add_edge("married", b)
+    with pytest.raises(SchemaViolationError):
+        a.add_edge("married", b)
+    tx.commit()
+
+
+def test_remove_edge_and_vertex(fresh_graph):
+    g = fresh_graph
+    tx = g.new_transaction()
+    a = tx.add_vertex(name="a")
+    b = tx.add_vertex(name="b")
+    e = a.add_edge("knows", b)
+    tx.commit()
+    tx = g.new_transaction()
+    a2 = tx.vertex(a.id)
+    edges = list(a2.out_edges("knows"))
+    assert len(edges) == 1
+    edges[0].remove()
+    assert list(a2.out_edges("knows")) == []  # delta visible pre-commit
+    tx.commit()
+    tx = g.new_transaction()
+    assert list(tx.vertex(a.id).out_edges("knows")) == []
+    # remove vertex b entirely
+    tx.vertex(b.id).remove()
+    tx.commit()
+    tx = g.new_transaction()
+    assert tx.vertex(b.id) is None
+    assert tx.vertex(a.id) is not None
+    tx.commit()
+
+
+def test_tx_isolation_and_rollback(fresh_graph):
+    g = fresh_graph
+    tx1 = g.new_transaction()
+    v = tx1.add_vertex(name="iso")
+    vid = v.id
+    tx2 = g.new_transaction()
+    assert tx2.vertex(vid) is None      # uncommitted invisible
+    tx1.rollback()
+    tx3 = g.new_transaction()
+    assert tx3.vertex(vid) is None      # rolled back, never persisted
+    tx2.rollback()
+    tx3.rollback()
+
+
+def test_vertex_iteration(fresh_graph):
+    g = fresh_graph
+    tx = g.new_transaction()
+    for i in range(20):
+        tx.add_vertex(idx=i)
+    tx.commit()
+    tx = g.new_transaction()
+    assert sum(1 for _ in tx.vertices()) == 20
+    tx.commit()
+
+
+def test_schema_persists_across_reopen(tmp_path):
+    path = str(tmp_path / "db")
+    g = titan_tpu.open({"storage.backend": "sqlite", "storage.directory": path})
+    g.management().make_property_key("age", int)
+    g.management().make_edge_label("father", Multiplicity.MANY2ONE)
+    tx = g.new_transaction()
+    v = tx.add_vertex(age=5)
+    vid = v.id
+    tx.commit()
+    g.close()
+
+    g2 = titan_tpu.open({"storage.backend": "sqlite", "storage.directory": path})
+    pk = g2.management().get_property_key("age")
+    assert pk is not None and pk.dtype is int
+    el = g2.management().get_edge_label("father")
+    assert el is not None and el.multiplicity is Multiplicity.MANY2ONE
+    tx = g2.new_transaction()
+    assert tx.vertex(vid).value("age") == 5
+    tx.commit()
+    g2.close()
+
+
+class TestGraphOfTheGods:
+    @pytest.fixture
+    def gods(self, fresh_graph):
+        return example.load(fresh_graph)
+
+    def test_load_counts(self, gods):
+        tx = gods.new_transaction()
+        vs = list(tx.vertices())
+        assert len(vs) == 12
+        n_edges = sum(1 for v in vs for _ in v.out_edges())
+        assert n_edges == 17
+        tx.commit()
+
+    def test_traversals(self, gods):
+        g = gods.traversal()
+        assert g.V().count().next() == 12
+        assert g.V().has("name", "hercules").out("father").values("name") \
+            .to_list() == ["jupiter"]
+        # grandfather
+        assert g.V().has("name", "hercules").out("father").out("father") \
+            .values("name").to_list() == ["saturn"]
+        battled = g.V().has("name", "hercules").out_e("battled") \
+            .has("time", __import__("titan_tpu.query", fromlist=["P"]).P.gt(1)) \
+            .in_v().values("name").to_list()
+        assert sorted(battled) == ["cerberus", "hydra"]
+        gods.rollback()
+
+    def test_two_hop_count(self, gods):
+        g = gods.traversal()
+        # BASELINE config #1: g.V().out().out().count()
+        assert g.V().out().out().count().next() == 28
+        gods.rollback()
+
+    def test_vertex_centric_interval(self, gods):
+        tx = gods.new_transaction()
+        herc = next(v for v in tx.vertices() if v.value("name") == "hercules")
+        q = herc.query().labels("battled").direction(Direction.OUT) \
+            .interval("time", 2, 13)
+        assert sorted(e.value("time") for e in q.edges()) == [2, 12]
+        assert q.count() == 2
+        tx.commit()
+
+    def test_label_groups(self, gods):
+        g = gods.traversal()
+        counts = g.V().group_count("label").next()
+        assert counts == {"titan": 1, "location": 3, "god": 3, "demigod": 1,
+                          "human": 1, "monster": 3}
+        gods.rollback()

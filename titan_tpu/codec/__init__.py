@@ -1,0 +1,6 @@
+from titan_tpu.codec.attributes import Serializer, DEFAULT as DEFAULT_SERIALIZER
+from titan_tpu.codec.dataio import DataOutput, ReadBuffer
+from titan_tpu.codec.edges import EdgeCodec, RelationCache, TypeInspector
+
+__all__ = ["Serializer", "DEFAULT_SERIALIZER", "DataOutput", "ReadBuffer",
+           "EdgeCodec", "RelationCache", "TypeInspector"]

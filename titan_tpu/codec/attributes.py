@@ -1,0 +1,287 @@
+"""Attribute (property-value) serializer registry.
+
+Counterpart of the reference's data serializer (reference: titan-core
+graphdb/database/serialize/StandardSerializer.java:430 and the ~29 attribute
+serializers under serialize/attribute/): a registry of typed codecs, each
+with a normal variant and — for types usable in sort keys and composite-index
+keys — a BYTE-ORDER-PRESERVING variant whose encoded bytes compare like the
+values themselves.
+
+Order-preserving encodings:
+* unsigned/signed ints  — big-endian with the sign bit flipped;
+* floats               — IEEE-754 bits; if negative, all bits flipped, else
+                         sign bit flipped (standard total-order trick);
+* strings              — UTF-8 bytes with 0x00 escaped as 0x00 0xFF and a
+                         0x00 0x00 terminator, so no encoded string is a
+                         prefix of another and order is preserved;
+* bytes                — same escape scheme;
+* bool/date/uuid       — derived from the above.
+
+The wire format for a *self-describing* value is [type-code u8][payload];
+order-preserving values are written raw (the schema supplies the type).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import struct
+import uuid as _uuid
+from typing import Any, Callable, Optional
+
+from titan_tpu.codec.dataio import DataOutput, ReadBuffer
+
+
+class AttributeHandler:
+    def __init__(self, code: int, py_type: type, write, read,
+                 write_ordered=None, read_ordered=None):
+        self.code = code
+        self.py_type = py_type
+        self.write = write
+        self.read = read
+        self.write_ordered = write_ordered or write
+        self.read_ordered = read_ordered or read
+
+    @property
+    def orderable(self) -> bool:
+        return self.write_ordered is not self.write or self.read_ordered is not self.read
+
+
+# -- primitives ---------------------------------------------------------------
+
+_SIGN = 1 << 63
+
+
+def _w_long(out: DataOutput, v: int):
+    out.put_svar(int(v))
+
+
+def _r_long(buf: ReadBuffer) -> int:
+    return buf.get_svar()
+
+
+def _w_long_ordered(out: DataOutput, v: int):
+    out.put_u64((int(v) + _SIGN) & ((1 << 64) - 1))  # flip sign bit
+
+
+def _r_long_ordered(buf: ReadBuffer) -> int:
+    return buf.get_u64() - _SIGN
+
+
+def _w_f64(out: DataOutput, v: float):
+    out.put_f64(float(v))
+
+
+def _r_f64(buf: ReadBuffer) -> float:
+    return buf.get_f64()
+
+
+def _w_f64_ordered(out: DataOutput, v: float):
+    bits = struct.unpack(">Q", struct.pack(">d", float(v)))[0]
+    if bits & _SIGN:
+        bits = ~bits & ((1 << 64) - 1)
+    else:
+        bits |= _SIGN
+    out.put_u64(bits)
+
+
+def _r_f64_ordered(buf: ReadBuffer) -> float:
+    bits = buf.get_u64()
+    if bits & _SIGN:
+        bits &= ~_SIGN & ((1 << 64) - 1)
+    else:
+        bits = ~bits & ((1 << 64) - 1)
+    return struct.unpack(">d", struct.pack(">Q", bits))[0]
+
+
+def _escape(b: bytes) -> bytes:
+    return b.replace(b"\x00", b"\x00\xff") + b"\x00\x00"
+
+
+def _unescape(buf: ReadBuffer) -> bytes:
+    out = bytearray()
+    data, pos, end = buf.data, buf.pos, buf.end
+    while pos < end:
+        c = data[pos]
+        if c == 0x00:
+            nxt = data[pos + 1]
+            if nxt == 0x00:        # terminator
+                buf.pos = pos + 2
+                return bytes(out)
+            if nxt == 0xFF:        # escaped zero
+                out.append(0x00)
+                pos += 2
+                continue
+            raise ValueError("bad escape in ordered bytes")
+        out.append(c)
+        pos += 1
+    raise ValueError("unterminated ordered bytes")
+
+
+def _w_str(out: DataOutput, v: str):
+    b = v.encode("utf-8")
+    out.put_uvar(len(b))
+    out.put_bytes(b)
+
+
+def _r_str(buf: ReadBuffer) -> str:
+    n = buf.get_uvar()
+    return buf.get_bytes(n).decode("utf-8")
+
+
+def _w_str_ordered(out: DataOutput, v: str):
+    out.put_bytes(_escape(v.encode("utf-8")))
+
+
+def _r_str_ordered(buf: ReadBuffer) -> str:
+    return _unescape(buf).decode("utf-8")
+
+
+def _w_bytes(out: DataOutput, v: bytes):
+    out.put_uvar(len(v))
+    out.put_bytes(bytes(v))
+
+
+def _r_bytes(buf: ReadBuffer) -> bytes:
+    return buf.get_bytes(buf.get_uvar())
+
+
+def _w_bytes_ordered(out: DataOutput, v: bytes):
+    out.put_bytes(_escape(bytes(v)))
+
+
+def _w_bool(out: DataOutput, v: bool):
+    out.put_u8(1 if v else 0)
+
+
+def _r_bool(buf: ReadBuffer) -> bool:
+    return buf.get_u8() != 0
+
+
+def _w_uuid(out: DataOutput, v: _uuid.UUID):
+    out.put_bytes(v.bytes)
+
+
+def _r_uuid(buf: ReadBuffer) -> _uuid.UUID:
+    return _uuid.UUID(bytes=buf.get_bytes(16))
+
+
+def _w_date(out: DataOutput, v: _dt.datetime):
+    if v.tzinfo is None:
+        v = v.replace(tzinfo=_dt.timezone.utc)
+    _w_long(out, int(v.timestamp() * 1_000_000))
+
+
+def _r_date(buf: ReadBuffer) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(_r_long(buf) / 1_000_000, _dt.timezone.utc)
+
+
+def _w_date_ordered(out: DataOutput, v: _dt.datetime):
+    if v.tzinfo is None:
+        v = v.replace(tzinfo=_dt.timezone.utc)
+    _w_long_ordered(out, int(v.timestamp() * 1_000_000))
+
+
+def _r_date_ordered(buf: ReadBuffer) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(_r_long_ordered(buf) / 1_000_000,
+                                      _dt.timezone.utc)
+
+
+class Serializer:
+    """Type registry + self-describing value codec."""
+
+    def __init__(self):
+        self._by_code: dict[int, AttributeHandler] = {}
+        self._by_type: dict[type, AttributeHandler] = {}
+        # codes are part of the stored format — never renumber
+        self.register(AttributeHandler(1, bool, _w_bool, _r_bool))
+        self.register(AttributeHandler(2, int, _w_long, _r_long,
+                                       _w_long_ordered, _r_long_ordered))
+        self.register(AttributeHandler(3, float, _w_f64, _r_f64,
+                                       _w_f64_ordered, _r_f64_ordered))
+        self.register(AttributeHandler(4, str, _w_str, _r_str,
+                                       _w_str_ordered, _r_str_ordered))
+        self.register(AttributeHandler(5, bytes, _w_bytes, _r_bytes,
+                                       _w_bytes_ordered,
+                                       lambda b: _unescape(b)))
+        self.register(AttributeHandler(6, _uuid.UUID, _w_uuid, _r_uuid))
+        self.register(AttributeHandler(7, _dt.datetime, _w_date, _r_date,
+                                       _w_date_ordered, _r_date_ordered))
+        self.register(AttributeHandler(8, list, self._w_list, self._r_list))
+        self.register(AttributeHandler(9, dict, self._w_dict, self._r_dict))
+        self.register(AttributeHandler(10, type(None),
+                                       lambda o, v: None, lambda b: None))
+
+    def register(self, h: AttributeHandler):
+        if h.code in self._by_code or h.py_type in self._by_type:
+            raise ValueError(f"duplicate attribute handler: {h.code}/{h.py_type}")
+        self._by_code[h.code] = h
+        self._by_type[h.py_type] = h
+
+    def handler_for(self, value_or_type) -> AttributeHandler:
+        t = value_or_type if isinstance(value_or_type, type) else type(value_or_type)
+        h = self._by_type.get(t)
+        if h is None:
+            for base, hh in self._by_type.items():
+                if base is not type(None) and issubclass(t, base):
+                    return hh
+            raise TypeError(f"no serializer registered for {t.__name__}")
+        return h
+
+    # -- self-describing values ([code u8][payload]) -------------------------
+
+    def write_value(self, out: DataOutput, value: Any) -> None:
+        h = self.handler_for(value)
+        out.put_u8(h.code)
+        h.write(out, value)
+
+    def read_value(self, buf: ReadBuffer) -> Any:
+        h = self._by_code[buf.get_u8()]
+        return h.read(buf)
+
+    def value_bytes(self, value: Any) -> bytes:
+        out = DataOutput()
+        self.write_value(out, value)
+        return out.getvalue()
+
+    def value_from_bytes(self, b: bytes) -> Any:
+        return self.read_value(ReadBuffer(b))
+
+    # -- order-preserving values (schema-typed, raw payload) -----------------
+
+    def write_ordered(self, out: DataOutput, value: Any, py_type: type) -> None:
+        h = self._by_type.get(py_type) or self.handler_for(value)
+        if not h.orderable:
+            raise TypeError(f"{py_type.__name__} has no order-preserving codec")
+        h.write_ordered(out, value)
+
+    def read_ordered(self, buf: ReadBuffer, py_type: type) -> Any:
+        h = self._by_type[py_type]
+        return h.read_ordered(buf)
+
+    def ordered_bytes(self, value: Any, py_type: Optional[type] = None) -> bytes:
+        out = DataOutput()
+        self.write_ordered(out, value, py_type or type(value))
+        return out.getvalue()
+
+    # -- containers ----------------------------------------------------------
+
+    def _w_list(self, out: DataOutput, v: list):
+        out.put_uvar(len(v))
+        for item in v:
+            self.write_value(out, item)
+
+    def _r_list(self, buf: ReadBuffer) -> list:
+        return [self.read_value(buf) for _ in range(buf.get_uvar())]
+
+    def _w_dict(self, out: DataOutput, v: dict):
+        out.put_uvar(len(v))
+        for key, val in v.items():
+            self.write_value(out, key)
+            self.write_value(out, val)
+
+    def _r_dict(self, buf: ReadBuffer) -> dict:
+        return {self.read_value(buf): self.read_value(buf)
+                for _ in range(buf.get_uvar())}
+
+
+DEFAULT = Serializer()

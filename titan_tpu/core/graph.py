@@ -1,0 +1,194 @@
+"""StandardGraph: graph lifetime and the commit orchestration.
+
+(reference: titan-core graphdb/database/StandardTitanGraph.java:78-808 —
+opens the Backend, builds serializers/caches/id-assigner, registers the
+instance, and hosts the commit path that turns a transaction's deltas into
+batched per-row store mutations.)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid as _uuid
+from typing import Optional
+
+from titan_tpu.codec.attributes import Serializer
+from titan_tpu.codec.edges import EdgeCodec
+from titan_tpu.config import (Configuration, MapConfiguration, defaults as d)
+from titan_tpu.core.defs import Direction, RelationCategory
+from titan_tpu.core.schema import SchemaManager
+from titan_tpu.core.tx import GraphTransaction
+from titan_tpu.errors import TitanError
+from titan_tpu.ids import IDManager
+from titan_tpu.ids.assigner import IDAssigner
+from titan_tpu.storage.api import Entry
+from titan_tpu.storage.backend import Backend
+
+
+class StandardGraph:
+    def __init__(self, config: Configuration):
+        self.config = config
+        self.instance_id = config.get(d.UNIQUE_INSTANCE_ID) or \
+            f"{os.getpid()}-{_uuid.uuid4().hex[:8]}"
+        self.idm = IDManager(
+            partition_bits=(config.get(d.MAX_PARTITIONS)).bit_length() - 1)
+        self.backend = Backend(config, instance_id=self.instance_id)
+        self.serializer = Serializer()
+        self.codec = EdgeCodec(self.serializer, self.idm)
+        self.id_assigner = IDAssigner(
+            self.idm, self.backend.id_authority,
+            block_size=config.get(d.IDS_BLOCK_SIZE),
+            renew_percentage=config.get(d.IDS_RENEW_PERCENTAGE))
+        self.schema = SchemaManager(self)
+        self.auto_schema = True
+        self.allow_custom_vid = config.get(d.ALLOW_SETTING_VERTEX_ID)
+        self._open = True
+        self._tlocal = threading.local()
+        self._index_providers: dict = {}   # name -> IndexProvider (index milestone)
+        self._commit_lock = threading.Lock()
+
+    # -- transactions --------------------------------------------------------
+
+    def new_transaction(self, read_only: bool = False) -> GraphTransaction:
+        self._check_open()
+        return GraphTransaction(self, read_only=read_only)
+
+    def tx(self) -> GraphTransaction:
+        """Thread-bound current transaction (reference: thread-bound tx in
+        TitanBlueprintsGraph)."""
+        cur = getattr(self._tlocal, "tx", None)
+        if cur is None or not cur.is_open:
+            cur = self.new_transaction()
+            self._tlocal.tx = cur
+        return cur
+
+    def traversal(self):
+        from titan_tpu.traversal.dsl import GraphTraversalSource
+        return GraphTraversalSource(self)
+
+    def open_index_txs(self) -> dict:
+        return {name: provider.begin_transaction()
+                for name, provider in self._index_providers.items()}
+
+    # -- convenience (delegate to the thread tx) ----------------------------
+
+    def add_vertex(self, label: Optional[str] = None, **props):
+        return self.tx().add_vertex(label, **props)
+
+    def vertex(self, vid: int):
+        return self.tx().vertex(vid)
+
+    def vertices(self):
+        return self.tx().vertices()
+
+    def commit(self):
+        cur = getattr(self._tlocal, "tx", None)
+        if cur is not None and cur.is_open:
+            cur.commit()
+        self._tlocal.tx = None
+
+    def rollback(self):
+        cur = getattr(self._tlocal, "tx", None)
+        if cur is not None and cur.is_open:
+            cur.rollback()
+        self._tlocal.tx = None
+
+    # -- management ----------------------------------------------------------
+
+    def management(self):
+        from titan_tpu.core.management import ManagementSystem
+        return ManagementSystem(self)
+
+    def compute(self, backend: Optional[str] = None):
+        from titan_tpu.olap import graph_computer
+        return graph_computer(self, backend or self.config.get(d.COMPUTER_BACKEND))
+
+    # -- commit orchestration (reference: StandardTitanGraph.commit) ---------
+
+    def commit_transaction(self, tx: GraphTransaction) -> None:
+        additions: dict[bytes, list] = {}
+        deletions: dict[bytes, list] = {}
+
+        def add(vid: int, entry: Entry):
+            additions.setdefault(self.idm.key_bytes(vid), []).append(entry)
+
+        def delete(vid: int, column: bytes):
+            deletions.setdefault(self.idm.key_bytes(vid), []).append(column)
+
+        # deleted relations first (an added SINGLE property both deletes the
+        # old entry and writes the new one on the same column — consolidation
+        # in the mutator keeps the addition; reference: prepareCommit order)
+        for rel in tx._deleted.values():
+            for vid, entry in self._serialize(rel):
+                delete(vid, entry.column)
+        for rel in tx._added.values():
+            for vid, entry in self._serialize(rel):
+                add(vid, entry)
+
+        btx = tx.backend_tx
+        with self._commit_lock:
+            for key in set(additions) | set(deletions):
+                btx.mutate_edges(
+                    key,
+                    additions.get(key, ()),
+                    deletions.get(key, ()))
+            try:
+                btx.commit()
+            except BaseException:
+                try:
+                    btx.rollback()
+                finally:
+                    pass
+                raise
+
+    def _serialize(self, rel):
+        """Yield (vertex_id, Entry) per materialized endpoint row."""
+        if rel.is_property:
+            yield rel.out_vertex_id, self.codec.write_property(
+                rel.type_id, rel.relation_id, rel.value, self.schema)
+            return
+        # edge: OUT row always; IN row unless unidirected or endpoint is a
+        # schema vertex (vertex-label edges only materialize on the OUT side)
+        yield rel.out_vertex_id, self.codec.write_edge(
+            rel.type_id, rel.relation_id, Direction.OUT, rel.in_vertex_id,
+            self.schema, rel.properties)
+        unidirected = False
+        st = self.schema.get_type(rel.type_id) \
+            if not self.schema.system.is_system(rel.type_id) else None
+        if st is not None and getattr(st, "unidirected", False):
+            unidirected = True
+        if self.idm.is_schema_id(rel.in_vertex_id):
+            unidirected = True
+        if not unidirected:
+            yield rel.in_vertex_id, self.codec.write_edge(
+                rel.type_id, rel.relation_id, Direction.IN, rel.out_vertex_id,
+                self.schema, rel.properties)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _check_open(self):
+        if not self._open:
+            raise TitanError("graph is closed")
+
+    @property
+    def is_open(self) -> bool:
+        return self._open
+
+    def close(self) -> None:
+        if not self._open:
+            return
+        self._open = False
+        self.id_assigner.close()
+        self.backend.close()
+
+    def clear(self) -> None:
+        """Drop all data (test helper; reference: TitanCleanup)."""
+        self.backend.clear_storage()
+        self.schema.expire()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

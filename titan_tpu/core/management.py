@@ -1,0 +1,65 @@
+"""Management system: schema DDL surface.
+
+(reference: titan-core graphdb/database/management/ManagementSystem.java:1304
+— schema creation/inspection; index lifecycle (SchemaAction) and instance
+management land with the index subsystem.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from titan_tpu.core.defs import Cardinality, Multiplicity
+from titan_tpu.core.schema import EdgeLabel, PropertyKey, VertexLabel
+
+
+class ManagementSystem:
+    def __init__(self, graph):
+        self.graph = graph
+        self.schema = graph.schema
+        self._open = True
+
+    # -- makers --------------------------------------------------------------
+
+    def make_property_key(self, name: str, dtype: type = str,
+                          cardinality: Cardinality = Cardinality.SINGLE
+                          ) -> PropertyKey:
+        return self.schema.make_property_key(name, dtype, cardinality)
+
+    def make_edge_label(self, name: str,
+                        multiplicity: Multiplicity = Multiplicity.MULTI,
+                        unidirected: bool = False,
+                        sort_key: tuple = ()) -> EdgeLabel:
+        return self.schema.make_edge_label(name, multiplicity, unidirected,
+                                           sort_key)
+
+    def make_vertex_label(self, name: str, partitioned: bool = False,
+                          static: bool = False) -> VertexLabel:
+        return self.schema.make_vertex_label(name, partitioned, static)
+
+    # -- inspection ----------------------------------------------------------
+
+    def get_property_key(self, name: str) -> Optional[PropertyKey]:
+        st = self.schema.get_by_name(name)
+        return st if isinstance(st, PropertyKey) else None
+
+    def get_edge_label(self, name: str) -> Optional[EdgeLabel]:
+        st = self.schema.get_by_name(name)
+        return st if isinstance(st, EdgeLabel) else None
+
+    def get_vertex_label(self, name: str) -> Optional[VertexLabel]:
+        st = self.schema.get_by_name(name)
+        return st if isinstance(st, VertexLabel) else None
+
+    def contains_relation_type(self, name: str) -> bool:
+        st = self.schema.get_by_name(name)
+        return isinstance(st, (PropertyKey, EdgeLabel))
+
+    def contains_vertex_label(self, name: str) -> bool:
+        return isinstance(self.schema.get_by_name(name), VertexLabel)
+
+    def commit(self):
+        self._open = False
+
+    def rollback(self):
+        self._open = False

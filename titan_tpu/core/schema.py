@@ -1,0 +1,345 @@
+"""Schema model, storage and cache.
+
+Re-creation of the reference's schema-in-the-graph design (reference:
+titan-core graphdb/types/ — TitanSchemaVertex, TypeDefinitionMap,
+typemaker/*; cache in graphdb/database/cache/StandardSchemaCache.java):
+schema elements ARE vertices. A schema vertex's row in the edgestore holds
+its ~schemaname and ~typedefinition system properties; a system name index
+row in the graphindex store maps name → id so lookups need one slice each
+way. A process-wide SchemaCache fronts both directions.
+
+Auto schema creation (the reference's DefaultSchemaMaker): unknown property
+keys default to (type(value), SINGLE); unknown edge labels to MULTI.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field as dc_field
+from typing import Optional
+
+from titan_tpu.codec.attributes import Serializer
+from titan_tpu.codec.edges import EdgeCodec
+from titan_tpu.core.defs import Cardinality, Multiplicity, SchemaStatus
+from titan_tpu.core.system_types import SystemTypes
+from titan_tpu.errors import SchemaViolationError
+from titan_tpu.ids import IDManager, IDType
+from titan_tpu.storage.api import Entry, KeySliceQuery, SliceQuery
+
+_NAME_INDEX_PREFIX = b"\x00sn\x00"   # system rows in graphindex
+_NAME_COLUMN = b"\x00"
+
+# dtype registry: stored code <-> python type (extend via register_dtype)
+_DTYPES: dict[str, type] = {}
+_DTYPE_NAMES: dict[type, str] = {}
+
+
+def register_dtype(name: str, t: type) -> None:
+    _DTYPES[name] = t
+    _DTYPE_NAMES[t] = name
+
+
+import datetime as _dt
+import uuid as _uuid
+
+for _n, _t in [("bool", bool), ("int", int), ("float", float), ("str", str),
+               ("bytes", bytes), ("uuid", _uuid.UUID), ("datetime", _dt.datetime),
+               ("list", list), ("dict", dict)]:
+    register_dtype(_n, _t)
+
+
+@dataclass(frozen=True)
+class SchemaType:
+    id: int
+    name: str
+
+    @property
+    def is_property_key(self) -> bool:
+        return isinstance(self, PropertyKey)
+
+    @property
+    def is_edge_label(self) -> bool:
+        return isinstance(self, EdgeLabel)
+
+    @property
+    def is_vertex_label(self) -> bool:
+        return isinstance(self, VertexLabel)
+
+
+@dataclass(frozen=True)
+class PropertyKey(SchemaType):
+    dtype: type = str
+    cardinality: Cardinality = Cardinality.SINGLE
+    status: SchemaStatus = SchemaStatus.ENABLED
+
+    def definition(self) -> dict:
+        return {"kind": "key", "dtype": _DTYPE_NAMES[self.dtype],
+                "cardinality": self.cardinality.value,
+                "status": self.status.value}
+
+
+@dataclass(frozen=True)
+class EdgeLabel(SchemaType):
+    multiplicity: Multiplicity = Multiplicity.MULTI
+    unidirected: bool = False
+    sort_key: tuple = ()
+    status: SchemaStatus = SchemaStatus.ENABLED
+
+    def definition(self) -> dict:
+        return {"kind": "label", "multiplicity": self.multiplicity.value,
+                "unidirected": self.unidirected,
+                "sort_key": list(self.sort_key), "status": self.status.value}
+
+
+@dataclass(frozen=True)
+class VertexLabel(SchemaType):
+    partitioned: bool = False
+    static: bool = False
+
+    def definition(self) -> dict:
+        return {"kind": "vertexlabel", "partitioned": self.partitioned,
+                "static": self.static}
+
+
+def _from_definition(schema_id: int, name: str, d: dict) -> SchemaType:
+    kind = d["kind"]
+    if kind == "key":
+        return PropertyKey(schema_id, name, _DTYPES[d["dtype"]],
+                           Cardinality(d["cardinality"]),
+                           SchemaStatus(d.get("status", "enabled")))
+    if kind == "label":
+        return EdgeLabel(schema_id, name, Multiplicity(d["multiplicity"]),
+                         d.get("unidirected", False),
+                         tuple(d.get("sort_key", ())),
+                         SchemaStatus(d.get("status", "enabled")))
+    if kind == "vertexlabel":
+        return VertexLabel(schema_id, name, d.get("partitioned", False),
+                           d.get("static", False))
+    raise SchemaViolationError(f"unknown schema kind {kind!r}")
+
+
+class SchemaManager:
+    """Creates, stores, loads and caches schema types; implements the codec's
+    TypeInspector protocol for BOTH system and user types."""
+
+    def __init__(self, graph):
+        self._graph = graph
+        self.idm: IDManager = graph.idm
+        self.serializer: Serializer = graph.serializer
+        self.codec: EdgeCodec = graph.codec
+        self.system = SystemTypes(self.idm)
+        self._by_id: dict[int, SchemaType] = {}
+        self._by_name: dict[str, int] = {}
+        self._lock = threading.RLock()
+
+    # -- TypeInspector protocol (codec callbacks) ----------------------------
+
+    def is_edge_label(self, type_id: int) -> bool:
+        t = self.idm.id_type(type_id)
+        return t.is_edge_label
+
+    def data_type(self, key_id: int) -> type:
+        info = self.system.key_info(key_id)
+        if info is not None:
+            return info[1]
+        st = self.get_type(key_id)
+        assert isinstance(st, PropertyKey), key_id
+        return st.dtype
+
+    def cardinality(self, key_id: int) -> Cardinality:
+        info = self.system.key_info(key_id)
+        if info is not None:
+            return info[2]
+        st = self.get_type(key_id)
+        assert isinstance(st, PropertyKey)
+        return st.cardinality
+
+    def multiplicity(self, label_id: int) -> Multiplicity:
+        info = self.system.label_info(label_id)
+        if info is not None:
+            return info[1]
+        st = self.get_type(label_id)
+        assert isinstance(st, EdgeLabel)
+        return st.multiplicity
+
+    def sort_key(self, label_id: int) -> tuple:
+        if self.system.label_info(label_id) is not None:
+            return ()
+        st = self.get_type(label_id)
+        assert isinstance(st, EdgeLabel)
+        return st.sort_key
+
+    # -- lookup --------------------------------------------------------------
+
+    def get_type(self, schema_id: int) -> Optional[SchemaType]:
+        with self._lock:
+            st = self._by_id.get(schema_id)
+        if st is not None:
+            return st
+        st = self._load_by_id(schema_id)
+        if st is not None:
+            with self._lock:
+                self._by_id[schema_id] = st
+                self._by_name[st.name] = schema_id
+        return st
+
+    def get_by_name(self, name: str) -> Optional[SchemaType]:
+        with self._lock:
+            sid = self._by_name.get(name)
+        if sid is not None:
+            return self.get_type(sid)
+        sid = self._load_name_index(name)
+        if sid is None:
+            return None
+        return self.get_type(sid)
+
+    def contains(self, name: str) -> bool:
+        return self.get_by_name(name) is not None
+
+    # -- creation ------------------------------------------------------------
+
+    def make_property_key(self, name: str, dtype: type = str,
+                          cardinality: Cardinality = Cardinality.SINGLE
+                          ) -> PropertyKey:
+        if dtype not in _DTYPE_NAMES:
+            raise SchemaViolationError(f"unsupported dtype {dtype!r}")
+        sid = self._graph.id_assigner.next_schema_id(IDType.USER_PROPERTY_KEY)
+        return self._store_type(PropertyKey(sid, name, dtype, cardinality))
+
+    def make_edge_label(self, name: str,
+                        multiplicity: Multiplicity = Multiplicity.MULTI,
+                        unidirected: bool = False,
+                        sort_key: tuple = ()) -> EdgeLabel:
+        for key_id in sort_key:
+            if not isinstance(self.get_type(key_id), PropertyKey):
+                raise SchemaViolationError("sort key must be property keys")
+            if self.data_type(key_id) not in (int, float, str, bytes,
+                                              _dt.datetime, bool):
+                raise SchemaViolationError("sort key dtype must be orderable")
+        sid = self._graph.id_assigner.next_schema_id(IDType.USER_EDGE_LABEL)
+        return self._store_type(EdgeLabel(sid, name, multiplicity,
+                                          unidirected, tuple(sort_key)))
+
+    def make_vertex_label(self, name: str, partitioned: bool = False,
+                          static: bool = False) -> VertexLabel:
+        sid = self._graph.id_assigner.next_schema_id(IDType.VERTEX_LABEL)
+        return self._store_type(VertexLabel(sid, name, partitioned, static))
+
+    # auto schema maker (reference: DefaultSchemaMaker)
+    def get_or_create_key(self, name: str, sample_value=None) -> PropertyKey:
+        st = self.get_by_name(name)
+        if st is not None:
+            if not isinstance(st, PropertyKey):
+                raise SchemaViolationError(f"{name!r} is not a property key")
+            return st
+        if self._graph.auto_schema is False:
+            raise SchemaViolationError(f"unknown property key {name!r} "
+                                       f"(auto schema disabled)")
+        dtype = type(sample_value) if sample_value is not None else str
+        if dtype not in _DTYPE_NAMES:
+            for base in _DTYPE_NAMES:
+                if isinstance(sample_value, base):
+                    dtype = base
+                    break
+        return self.make_property_key(name, dtype)
+
+    def get_or_create_label(self, name: str) -> EdgeLabel:
+        st = self.get_by_name(name)
+        if st is not None:
+            if not isinstance(st, EdgeLabel):
+                raise SchemaViolationError(f"{name!r} is not an edge label")
+            return st
+        if self._graph.auto_schema is False:
+            raise SchemaViolationError(f"unknown edge label {name!r}")
+        return self.make_edge_label(name)
+
+    def get_or_create_vertex_label(self, name: str) -> VertexLabel:
+        st = self.get_by_name(name)
+        if st is not None:
+            if not isinstance(st, VertexLabel):
+                raise SchemaViolationError(f"{name!r} is not a vertex label")
+            return st
+        if self._graph.auto_schema is False:
+            raise SchemaViolationError(f"unknown vertex label {name!r}")
+        return self.make_vertex_label(name)
+
+    def update_type(self, st: SchemaType) -> SchemaType:
+        """Rewrite a type's definition (index lifecycle transitions etc.)."""
+        return self._store_type(st, expect_new=False)
+
+    # -- storage -------------------------------------------------------------
+
+    def _name_index_key(self, name: str) -> bytes:
+        return _NAME_INDEX_PREFIX + name.encode("utf-8")
+
+    def _store_type(self, st: SchemaType, expect_new: bool = True) -> SchemaType:
+        if expect_new and self.get_by_name(st.name) is not None:
+            raise SchemaViolationError(f"schema name already exists: {st.name!r}")
+        backend = self._graph.backend
+        txh = backend.manager.begin_transaction()
+        try:
+            key = self.idm.key_bytes(st.id)
+            name_entry = self.codec.write_property(
+                self.system.schema_name, self._graph.id_assigner.next_relation_id(),
+                st.name, self)
+            def_entry = self.codec.write_property(
+                self.system.type_definition,
+                self._graph.id_assigner.next_relation_id(),
+                st.definition(), self)
+            backend.edge_store.store.mutate(key, [name_entry, def_entry], [], txh)
+            backend.index_store.store.mutate(
+                self._name_index_key(st.name),
+                [Entry(_NAME_COLUMN, st.id.to_bytes(8, "big"))], [], txh)
+            txh.commit()
+        except BaseException:
+            txh.rollback()
+            raise
+        with self._lock:
+            self._by_id[st.id] = st
+            self._by_name[st.name] = st.id
+        backend.edge_store.invalidate(self.idm.key_bytes(st.id))
+        return st
+
+    def _load_name_index(self, name: str) -> Optional[int]:
+        backend = self._graph.backend
+        txh = backend.manager.begin_transaction()
+        try:
+            entries = backend.index_store.store.get_slice(
+                KeySliceQuery(self._name_index_key(name), SliceQuery()), txh)
+        finally:
+            txh.commit()
+        if not entries:
+            return None
+        return int.from_bytes(entries[0].value, "big")
+
+    def _load_by_id(self, schema_id: int) -> Optional[SchemaType]:
+        if not self.idm.is_schema_id(schema_id):
+            return None
+        backend = self._graph.backend
+        txh = backend.manager.begin_transaction()
+        try:
+            entries = backend.edge_store.store.get_slice(
+                KeySliceQuery(self.idm.key_bytes(schema_id), SliceQuery()), txh)
+        finally:
+            txh.commit()
+        name = None
+        definition = None
+        for e in entries:
+            rc = self.codec.parse(e, self)
+            if rc.type_id == self.system.schema_name:
+                name = rc.value
+            elif rc.type_id == self.system.type_definition:
+                definition = rc.value
+        if name is None or definition is None:
+            return None
+        return _from_definition(schema_id, name, definition)
+
+    def expire(self, schema_id: Optional[int] = None) -> None:
+        with self._lock:
+            if schema_id is None:
+                self._by_id.clear()
+                self._by_name.clear()
+            else:
+                st = self._by_id.pop(schema_id, None)
+                if st is not None:
+                    self._by_name.pop(st.name, None)

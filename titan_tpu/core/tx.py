@@ -1,0 +1,463 @@
+"""Graph transaction: element caches, read-your-writes queries, commit.
+
+Re-creation of the reference's transaction engine (reference: titan-core
+graphdb/transaction/StandardTitanTx.java:83-1414 — per-tx vertex cache,
+added/deleted relation sets, the ``edgeProcessor`` merge of stored slices
+with in-tx deltas :1049-1122, commit/rollback :1344-1390) and the graph
+commit path (graphdb/database/StandardTitanGraph.java prepareCommit
+:493-616, commit :634-789): added/deleted relations re-serialize through the
+deterministic edge codec into per-vertex-row mutation batches, flushed as one
+batched backend call.
+
+Constraint enforcement (reference: StandardTitanTx connectionEdges /
+MultiplicityConstraint checks): SINGLE-cardinality properties replace the
+previous value; SET rejects duplicates; unique edge directions
+(MANY2ONE/ONE2ONE/ONE2MANY) reject a second edge; SIMPLE rejects parallel
+edges.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Iterator, Optional
+
+from titan_tpu.core.defs import (Cardinality, Direction, ElementLifecycle,
+                                 Multiplicity, RelationCategory)
+from titan_tpu.core.elements import Edge, Vertex, VertexProperty
+from titan_tpu.core.relations import InternalRelation
+from titan_tpu.errors import (InvalidElementError, SchemaViolationError,
+                              TransactionClosedError)
+from titan_tpu.ids import IDType
+from titan_tpu.storage.api import Entry, KeySliceQuery, SliceQuery
+
+
+class GraphTransaction:
+    def __init__(self, graph, read_only: bool = False):
+        self.graph = graph
+        self.schema = graph.schema
+        self.codec = graph.codec
+        self.idm = graph.idm
+        self.read_only = read_only
+        self._backend_tx = None
+        self._open = True
+        self._lock = threading.RLock()
+
+        # caches & deltas
+        self._vertices: dict[int, Vertex] = {}
+        self._new_vertices: set[int] = set()
+        self._removed_vertices: set[int] = set()
+        self._vertex_labels: dict[int, int] = {}     # vid -> label schema id
+        self._added: dict[int, InternalRelation] = {}        # rel id -> rel
+        self._deleted: dict[int, InternalRelation] = {}      # rel id -> rel
+        self._added_by_vertex: dict[int, list] = {}          # vid -> [rel]
+
+    # ------------------------------------------------------------------ infra
+
+    @property
+    def backend_tx(self):
+        if self._backend_tx is None:
+            self._backend_tx = self.graph.backend.begin_transaction(
+                index_txs=self.graph.open_index_txs())
+        return self._backend_tx
+
+    def _check_open(self):
+        if not self._open:
+            raise TransactionClosedError("transaction is closed")
+
+    @property
+    def is_open(self) -> bool:
+        return self._open
+
+    def vertex_handle(self, vid: int) -> Vertex:
+        v = self._vertices.get(vid)
+        if v is None:
+            v = Vertex(self, vid)
+            self._vertices[vid] = v
+        return v
+
+    def schema_name(self, type_id: int) -> str:
+        name = self.schema.system.name_of(type_id)
+        if name is not None:
+            return name
+        st = self.schema.get_type(type_id)
+        if st is None:
+            raise InvalidElementError(f"unknown schema id {type_id}")
+        return st.name
+
+    # ---------------------------------------------------------------- writes
+
+    def add_vertex(self, label: Optional[str] = None, vertex_id: Optional[int] = None,
+                   **props) -> Vertex:
+        self._check_open()
+        if self.read_only:
+            raise SchemaViolationError("read-only transaction")
+        label_type = None
+        if label is not None:
+            label_type = self.schema.get_or_create_vertex_label(label)
+        if vertex_id is not None:
+            if not self.graph.allow_custom_vid:
+                raise SchemaViolationError(
+                    "custom vertex ids disabled (graph.set-vertex-id)")
+            vid = vertex_id
+        else:
+            idtype = IDType.NORMAL_VERTEX
+            if label_type is not None and label_type.partitioned:
+                idtype = IDType.PARTITIONED_VERTEX
+            vid = self.graph.id_assigner.next_vertex_id(idtype=idtype)
+        v = self.vertex_handle(vid)
+        self._new_vertices.add(vid)
+        # existence marker (reference: BaseKey.VertexExists)
+        self._add_relation(InternalRelation(
+            self.graph.id_assigner.next_relation_id(),
+            self.schema.system.vertex_exists, RelationCategory.PROPERTY,
+            vid, value=True))
+        if label_type is not None:
+            self._vertex_labels[vid] = label_type.id
+            self._add_relation(InternalRelation(
+                self.graph.id_assigner.next_relation_id(),
+                self.schema.system.vertex_label_edge, RelationCategory.EDGE,
+                vid, label_type.id))
+        for k, val in props.items():
+            self.add_property(v, k, val)
+        return v
+
+    def _add_relation(self, rel: InternalRelation) -> InternalRelation:
+        self._added[rel.relation_id] = rel
+        for vid in rel.vertex_ids():
+            if vid is not None and not self.idm.is_schema_id(vid):
+                self._added_by_vertex.setdefault(vid, []).append(rel)
+            elif vid is not None and self.idm.is_schema_id(vid):
+                # vertex-label edges point at schema vertices; only the OUT
+                # side materializes (labels don't list their members here)
+                pass
+        return rel
+
+    def add_property(self, v: Vertex, key: str, value: Any) -> VertexProperty:
+        self._check_open()
+        if self.read_only:
+            raise SchemaViolationError("read-only transaction")
+        self._check_vertex_writable(v.id)
+        pk = self.schema.get_or_create_key(key, value)
+        if not isinstance(value, pk.dtype) and pk.dtype is not None:
+            coerced = self._coerce(value, pk.dtype)
+            if coerced is None:
+                raise SchemaViolationError(
+                    f"value {value!r} is not a {pk.dtype.__name__} "
+                    f"(key {key!r})")
+            value = coerced
+        if pk.cardinality is Cardinality.SINGLE:
+            for p in self.vertex_properties(v.id, [key]):
+                self.remove_relation(p.rel)
+        elif pk.cardinality is Cardinality.SET:
+            for p in self.vertex_properties(v.id, [key]):
+                if p.rel.value == value:
+                    return p  # set semantics: already present
+        rel = self._add_relation(InternalRelation(
+            self.graph.id_assigner.next_relation_id(), pk.id,
+            RelationCategory.PROPERTY, v.id, value=value))
+        return VertexProperty(self, rel)
+
+    @staticmethod
+    def _coerce(value, dtype):
+        if dtype is float and isinstance(value, int):
+            return float(value)
+        if dtype is int and isinstance(value, bool):
+            return None
+        return None
+
+    def add_edge(self, out_v: Vertex, label: str, in_v: Vertex,
+                 props: Optional[dict] = None) -> Edge:
+        self._check_open()
+        if self.read_only:
+            raise SchemaViolationError("read-only transaction")
+        self._check_vertex_writable(out_v.id)
+        self._check_vertex_writable(in_v.id)
+        el = self.schema.get_or_create_label(label)
+        self._check_multiplicity(el, out_v, in_v)
+        rel = InternalRelation(
+            self.graph.id_assigner.next_relation_id(), el.id,
+            RelationCategory.EDGE, out_v.id, in_v.id)
+        for k, val in (props or {}).items():
+            pk = self.schema.get_or_create_key(k, val)
+            rel.properties[pk.id] = val
+        self._add_relation(rel)
+        return Edge(self, rel)
+
+    def _check_multiplicity(self, el, out_v: Vertex, in_v: Vertex):
+        mult = el.multiplicity
+        if mult is Multiplicity.MULTI:
+            return
+        if mult.unique(Direction.OUT) or mult is Multiplicity.SIMPLE:
+            for e in self.vertex_edges(out_v.id, Direction.OUT, [el.name]):
+                if mult is not Multiplicity.SIMPLE or \
+                        e.rel.other_vertex_id(out_v.id) == in_v.id:
+                    raise SchemaViolationError(
+                        f"multiplicity {mult.value} violated on {el.name!r} "
+                        f"(existing out-edge)")
+        if mult.unique(Direction.IN):
+            for _ in self.vertex_edges(in_v.id, Direction.IN, [el.name]):
+                raise SchemaViolationError(
+                    f"multiplicity {mult.value} violated on {el.name!r} "
+                    f"(existing in-edge)")
+
+    def _check_vertex_writable(self, vid: int):
+        if vid in self._removed_vertices:
+            raise InvalidElementError(f"vertex {vid} was removed in this tx")
+
+    def remove_relation(self, rel: InternalRelation) -> None:
+        self._check_open()
+        if self.read_only:
+            raise SchemaViolationError("read-only transaction")
+        if rel.relation_id in self._added:
+            del self._added[rel.relation_id]
+            for vid in rel.vertex_ids():
+                if vid is not None and vid in self._added_by_vertex:
+                    try:
+                        self._added_by_vertex[vid].remove(rel)
+                    except ValueError:
+                        pass
+        else:
+            rel.lifecycle = ElementLifecycle.REMOVED
+            self._deleted[rel.relation_id] = rel
+
+    def remove_vertex(self, v: Vertex) -> None:
+        self._check_open()
+        if self.read_only:
+            raise SchemaViolationError("read-only transaction")
+        # delete every incident relation (incl. existence + label edge)
+        for rel in list(self._iter_relations(v.id, Direction.BOTH, None,
+                                             RelationCategory.RELATION,
+                                             include_system=True)):
+            self.remove_relation(rel)
+        self._removed_vertices.add(v.id)
+        self._new_vertices.discard(v.id)
+
+    # ----------------------------------------------------------------- reads
+
+    def vertex(self, vid: int) -> Optional[Vertex]:
+        """Vertex by id, or None if it doesn't exist."""
+        self._check_open()
+        if vid in self._removed_vertices:
+            return None
+        if vid in self._new_vertices:
+            return self.vertex_handle(vid)
+        if not self.idm.is_user_vertex_id(vid):
+            return None
+        if self._vertex_exists(vid):
+            return self.vertex_handle(vid)
+        return None
+
+    def _vertex_exists(self, vid: int) -> bool:
+        [q] = self.codec.query_type(self.schema.system.vertex_exists,
+                                    Direction.OUT, self.schema)
+        entries = self.backend_tx.edge_store_query(
+            KeySliceQuery(self.idm.key_bytes(vid), q))
+        return bool(entries)
+
+    def vertices(self) -> Iterator[Vertex]:
+        """All vertices (full scan; reference: StandardTitanTx.java:1260-1282
+        full-scan fallback)."""
+        self._check_open()
+        [q] = self.codec.query_type(self.schema.system.vertex_exists,
+                                    Direction.OUT, self.schema)
+        seen = set()
+        for key, entries in self.backend_tx.edge_store_keys(q):
+            if not entries:
+                continue
+            vid = self.idm.id_of_key_bytes(key)
+            if not self.idm.is_user_vertex_id(vid):
+                continue
+            if vid in self._removed_vertices or vid in seen:
+                continue
+            seen.add(vid)
+            yield self.vertex_handle(vid)
+        for vid in sorted(self._new_vertices - seen):
+            if vid not in self._removed_vertices:
+                yield self.vertex_handle(vid)
+
+    def vertex_label_name(self, vid: int) -> str:
+        lid = self._vertex_labels.get(vid)
+        if lid is None:
+            for rel in self._iter_relations(vid, Direction.OUT, None,
+                                            RelationCategory.EDGE,
+                                            include_system=True):
+                if rel.type_id == self.schema.system.vertex_label_edge:
+                    lid = rel.in_vertex_id
+                    break
+            self._vertex_labels[vid] = lid if lid is not None else 0
+        if not lid:
+            return "vertex"
+        st = self.schema.get_type(lid)
+        return st.name if st else "vertex"
+
+    def vertex_properties(self, vid: int, keys: Optional[list] = None
+                          ) -> Iterator[VertexProperty]:
+        self._check_open()
+        type_ids = None
+        if keys is not None:
+            type_ids = []
+            for k in keys:
+                st = self.schema.get_by_name(k)
+                if st is not None:
+                    type_ids.append(st.id)
+            if not type_ids:
+                return
+        for rel in self._iter_relations(vid, Direction.OUT, type_ids,
+                                        RelationCategory.PROPERTY):
+            yield VertexProperty(self, rel)
+
+    def vertex_edges(self, vid: int, direction: Direction = Direction.BOTH,
+                     labels: Optional[list] = None) -> Iterator[Edge]:
+        self._check_open()
+        type_ids = None
+        if labels is not None:
+            type_ids = []
+            for name in labels:
+                st = self.schema.get_by_name(name)
+                if st is not None:
+                    type_ids.append(st.id)
+            if not type_ids:
+                return
+        for rel in self._iter_relations(vid, direction, type_ids,
+                                        RelationCategory.EDGE):
+            yield Edge(self, rel)
+
+    # the edgeProcessor: merge stored slices with the tx delta
+    def _iter_relations(self, vid: int, direction: Direction,
+                        type_ids: Optional[list], category: RelationCategory,
+                        include_system: bool = False) -> Iterator[InternalRelation]:
+        emitted: set[tuple] = set()
+        if vid not in self._new_vertices:
+            for rel in self._stored_relations(vid, direction, type_ids,
+                                              category, include_system):
+                key = (rel.relation_id, rel.direction_of(vid) if rel.is_edge
+                       else Direction.OUT)
+                if rel.relation_id in self._deleted or key in emitted:
+                    continue
+                emitted.add(key)
+                yield rel
+        for rel in self._added_by_vertex.get(vid, ()):  # in-tx additions
+            if not self._matches(rel, vid, direction, type_ids, category,
+                                 include_system):
+                continue
+            key = (rel.relation_id,
+                   rel.direction_of(vid) if rel.is_edge else Direction.OUT)
+            if key in emitted:
+                continue
+            emitted.add(key)
+            yield rel
+
+    def _matches(self, rel: InternalRelation, vid: int, direction: Direction,
+                 type_ids: Optional[list], category: RelationCategory,
+                 include_system: bool) -> bool:
+        if category is RelationCategory.EDGE and not rel.is_edge:
+            return False
+        if category is RelationCategory.PROPERTY and not rel.is_property:
+            return False
+        if type_ids is not None:
+            if rel.type_id not in type_ids:
+                return False
+        elif not include_system and self.schema.system.is_system(rel.type_id):
+            return False
+        if rel.is_edge:
+            d = rel.direction_of(vid)
+            if direction is not Direction.BOTH and d is not direction:
+                return False
+        return True
+
+    def _slices_for(self, direction, type_ids, category, include_system):
+        if type_ids is not None:
+            slices = []
+            for tid in type_ids:
+                slices.extend(self.codec.query_type(tid, direction, self.schema))
+            return slices
+        if category is RelationCategory.RELATION and include_system:
+            return [self.codec.query_all()]
+        return [self.codec.query_category(category, direction, include_system)]
+
+    def _stored_relations(self, vid, direction, type_ids, category,
+                          include_system) -> Iterator[InternalRelation]:
+        key = self.idm.key_bytes(vid)
+        for q in self._slices_for(direction, type_ids, category, include_system):
+            for entry in self.backend_tx.edge_store_query(KeySliceQuery(vid_key := key, q)):
+                rc = self.codec.parse(entry, self.schema)
+                rel = self._relation_from_cache(vid, rc)
+                if self._matches(rel, vid, direction, type_ids, category,
+                                 include_system):
+                    yield rel
+
+    def _relation_from_cache(self, vid: int, rc) -> InternalRelation:
+        if rc.category is RelationCategory.PROPERTY:
+            return InternalRelation(rc.relation_id, rc.type_id, rc.category,
+                                    vid, value=rc.value,
+                                    lifecycle=ElementLifecycle.LOADED)
+        if rc.direction is Direction.OUT:
+            out_id, in_id = vid, rc.other_vertex_id
+        else:
+            out_id, in_id = rc.other_vertex_id, vid
+        return InternalRelation(rc.relation_id, rc.type_id, rc.category,
+                                out_id, in_id, properties=dict(rc.properties),
+                                lifecycle=ElementLifecycle.LOADED)
+
+    # multi-vertex batched adjacency (reference: TitanMultiVertexQuery /
+    # edgeMultiQuery StandardTitanGraph.java:416-427)
+    def multi_vertex_edges(self, vids: list, direction: Direction = Direction.BOTH,
+                           labels: Optional[list] = None) -> dict:
+        self._check_open()
+        type_ids = None
+        if labels is not None:
+            type_ids = [st.id for name in labels
+                        if (st := self.schema.get_by_name(name)) is not None]
+            if not type_ids:
+                return {vid: [] for vid in vids}
+        out: dict[int, list] = {vid: [] for vid in vids}
+        stored_vids = [v for v in vids if v not in self._new_vertices]
+        keys = {self.idm.key_bytes(v): v for v in stored_vids}
+        for q in self._slices_for(direction, type_ids, RelationCategory.EDGE,
+                                  False):
+            if not keys:
+                break
+            result = self.backend_tx.edge_store_multi_query(list(keys), q)
+            for kb, entries in result.items():
+                vid = keys[kb]
+                for entry in entries:
+                    rc = self.codec.parse(entry, self.schema)
+                    rel = self._relation_from_cache(vid, rc)
+                    if rel.relation_id in self._deleted:
+                        continue
+                    if self._matches(rel, vid, direction, type_ids,
+                                     RelationCategory.EDGE, False):
+                        out[vid].append(Edge(self, rel))
+        for vid in vids:
+            for rel in self._added_by_vertex.get(vid, ()):
+                if self._matches(rel, vid, direction, type_ids,
+                                 RelationCategory.EDGE, False):
+                    out[vid].append(Edge(self, rel))
+        return out
+
+    # ------------------------------------------------------------- lifecycle
+
+    def commit(self) -> None:
+        self._check_open()
+        try:
+            if self._added or self._deleted:
+                self.graph.commit_transaction(self)
+            elif self._backend_tx is not None:
+                self._backend_tx.commit()
+        finally:
+            self._open = False
+
+    def rollback(self) -> None:
+        if not self._open:
+            return
+        try:
+            if self._backend_tx is not None:
+                self._backend_tx.rollback()
+        finally:
+            self._open = False
+        self._added.clear()
+        self._deleted.clear()
+        self._added_by_vertex.clear()
+
+    def has_modifications(self) -> bool:
+        return bool(self._added or self._deleted)

@@ -1,0 +1,68 @@
+"""GraphOfTheGods: the canonical demo dataset.
+
+(reference: titan-core titan/example/GraphOfTheGodsFactory.java:26,52 — same
+schema and data: 12 vertices (titan/god/demigod/human/monster/location),
+17 edges (father/mother/brother/battled/lives/pet) with battled sort-keyed
+by time and lives carrying a reason property.)
+"""
+
+from __future__ import annotations
+
+from titan_tpu.core.defs import Cardinality, Multiplicity
+
+
+def load(graph, batch: bool = False):
+    schema = graph.schema
+    name = schema.get_by_name("name") or schema.make_property_key("name", str)
+    age = schema.get_by_name("age") or schema.make_property_key("age", int)
+    time = schema.get_by_name("time") or schema.make_property_key("time", int)
+    reason = schema.get_by_name("reason") or schema.make_property_key("reason", str)
+
+    schema.get_by_name("father") or schema.make_edge_label(
+        "father", Multiplicity.MANY2ONE)
+    schema.get_by_name("mother") or schema.make_edge_label(
+        "mother", Multiplicity.MANY2ONE)
+    schema.get_by_name("battled") or schema.make_edge_label(
+        "battled", Multiplicity.MULTI, sort_key=(time.id,))
+    schema.get_by_name("lives") or schema.make_edge_label(
+        "lives", Multiplicity.MULTI)
+    schema.get_by_name("pet") or schema.make_edge_label("pet", Multiplicity.MULTI)
+    schema.get_by_name("brother") or schema.make_edge_label(
+        "brother", Multiplicity.MULTI)
+
+    for label in ["titan", "location", "god", "demigod", "human", "monster"]:
+        schema.get_by_name(label) or schema.make_vertex_label(label)
+
+    tx = graph.new_transaction()
+    saturn = tx.add_vertex("titan", name="saturn", age=10000)
+    sky = tx.add_vertex("location", name="sky")
+    sea = tx.add_vertex("location", name="sea")
+    jupiter = tx.add_vertex("god", name="jupiter", age=5000)
+    neptune = tx.add_vertex("god", name="neptune", age=4500)
+    hercules = tx.add_vertex("demigod", name="hercules", age=30)
+    alcmene = tx.add_vertex("human", name="alcmene", age=45)
+    pluto = tx.add_vertex("god", name="pluto", age=4000)
+    nemean = tx.add_vertex("monster", name="nemean")
+    hydra = tx.add_vertex("monster", name="hydra")
+    cerberus = tx.add_vertex("monster", name="cerberus")
+    tartarus = tx.add_vertex("location", name="tartarus")
+
+    jupiter.add_edge("father", saturn)
+    jupiter.add_edge("lives", sky, reason="loves fresh breezes")
+    jupiter.add_edge("brother", neptune)
+    jupiter.add_edge("brother", pluto)
+    neptune.add_edge("lives", sea, reason="loves waves")
+    neptune.add_edge("brother", jupiter)
+    neptune.add_edge("brother", pluto)
+    hercules.add_edge("father", jupiter)
+    hercules.add_edge("mother", alcmene)
+    hercules.add_edge("battled", nemean, time=1)
+    hercules.add_edge("battled", hydra, time=2)
+    hercules.add_edge("battled", cerberus, time=12)
+    pluto.add_edge("brother", jupiter)
+    pluto.add_edge("brother", neptune)
+    pluto.add_edge("lives", tartarus, reason="no fear of death")
+    pluto.add_edge("pet", cerberus)
+    cerberus.add_edge("lives", tartarus)
+    tx.commit()
+    return graph

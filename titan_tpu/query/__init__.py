@@ -1,0 +1,3 @@
+from titan_tpu.query.predicates import P
+
+__all__ = ["P"]

@@ -56,7 +56,10 @@ class SliceQuery:
             return False
         if self.limit is None:
             return True
-        return other.limit is not None and other.limit <= self.limit
+        # a limited result is only reusable for an equally-anchored query:
+        # with a different start, the limit may have cut different entries
+        return (other.limit is not None and other.limit <= self.limit and
+                self.start == other.start)
 
 
 @dataclass(frozen=True)

@@ -153,12 +153,6 @@ class InMemoryStoreManager(KeyColumnValueStoreManager):
                           ) -> StoreTransaction:
         return StoreTransaction(config)
 
-    def mutate_many(self, mutations: dict, txh: StoreTransaction) -> None:
-        for store_name, by_key in mutations.items():
-            store = self.open_database(store_name)
-            for key, m in by_key.items():
-                store.mutate(key, m.additions, m.deletions, txh)
-
     def close(self) -> None:
         pass
 

@@ -1,0 +1,42 @@
+"""Storage/index backend registry.
+
+(reference: titan-core diskstorage/StandardStoreManager.java:12-18,
+Backend.getStorageManager Backend.java:406-414 — shorthand → implementation
+map with reflective fallback to an import path.)
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable
+
+_STORE_FACTORIES: dict[str, Callable] = {}
+
+
+def register_store(shorthand: str, factory: Callable) -> None:
+    _STORE_FACTORIES[shorthand] = factory
+
+
+def store_manager(shorthand: str, **kwargs):
+    factory = _STORE_FACTORIES.get(shorthand)
+    if factory is not None:
+        return factory(**kwargs)
+    if "." in shorthand:  # import path "pkg.mod.Class"
+        mod, _, cls = shorthand.rpartition(".")
+        return getattr(importlib.import_module(mod), cls)(**kwargs)
+    raise ValueError(f"unknown storage backend {shorthand!r}; known: "
+                     f"{sorted(_STORE_FACTORIES)}")
+
+
+def _inmemory(**kw):
+    from titan_tpu.storage.inmemory import InMemoryStoreManager
+    return InMemoryStoreManager()
+
+
+def _sqlite(directory=None, read_only=False, **kw):
+    from titan_tpu.storage.sqlitekv import SqliteStoreManager
+    return SqliteStoreManager(directory, read_only)
+
+
+register_store("inmemory", _inmemory)
+register_store("sqlite", _sqlite)

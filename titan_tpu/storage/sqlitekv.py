@@ -83,15 +83,16 @@ class SqliteTransaction(StoreTransaction):
         with self._lock:
             if self.closed:
                 return
-            self.closed = True
             if self._conn is not None:
                 try:
                     self._conn.commit()
                 except sqlite3.OperationalError as e:
+                    # leave the tx OPEN so a retry actually re-commits instead
+                    # of hitting the closed-tx early exit and faking success
                     raise TemporaryBackendError(str(e)) from e
-                finally:
-                    self._conn.close()
-                    self._conn = None
+                self._conn.close()
+                self._conn = None
+            self.closed = True
 
     def rollback(self) -> None:
         with self._lock:

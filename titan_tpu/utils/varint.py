@@ -132,47 +132,67 @@ def read_signed_backward(buf, end: int, limit: int = 0) -> tuple[int, int]:
 
 
 # ---------------------------------------------------------------------------
-# prefixed variant: [prefix bits | value bits] packed into the same MSB-first
-# varint stream. The first byte carries the prefix in its top payload bits.
+# prefixed variant. Layout (same design as the reference's
+# VariableLong.writePositiveWithPrefix, VariableLong.java:145-173):
+#
+#   first byte:  [ prefix : P bits | continue : 1 bit | top value bits ]
+#   rest:        MSB-first 7-bit groups, stop bit (0x80) on the LAST byte
+#
+# Keeping the prefix in the TOP bits of byte 0 gives two properties the edge
+# codec depends on: (a) every encoding with prefix p lies in the one-byte
+# range [p<<d, (p+1)<<d) regardless of length → category slice bounds need
+# only the first byte; (b) encodings are prefix-free → a type's columns form
+# one contiguous range.
 # ---------------------------------------------------------------------------
-
-def prefixed_length(value: int, prefix_bit_len: int) -> int:
-    if value < 0:
-        raise ValueError("negative value")
-    total_bits = max(value.bit_length(), 1) + prefix_bit_len
-    return (total_bits + 6) // 7
-
 
 def write_positive_with_prefix(out: bytearray, value: int, prefix: int,
                                prefix_bit_len: int) -> None:
+    if not (0 < prefix_bit_len < 7):
+        raise ValueError("prefix_bit_len out of range")
     if prefix < 0 or prefix >= (1 << prefix_bit_len):
         raise ValueError("prefix out of range")
-    combined_bits = max(value.bit_length(), 1)
-    ngroups = (combined_bits + prefix_bit_len + 6) // 7
-    payload_bits = 7 * ngroups - prefix_bit_len
-    combined = (prefix << payload_bits) | value
-    nbytes = ngroups
-    first_shift = 7 * (nbytes - 1)
-    for shift in range(first_shift, 6, -7):
-        out.append((combined >> shift) & _MASK)
-    out.append((combined & _MASK) | _STOP)
+    if value < 0:
+        raise ValueError("negative value")
+    delta = 8 - prefix_bit_len          # bits in first byte below the prefix
+    first = prefix << delta
+    vlen = max(value.bit_length(), 1)
+    mod = vlen % 7
+    if mod <= delta - 1:
+        offset = vlen - mod             # top `mod` bits ride in the first byte
+        first |= value >> offset
+        value &= (1 << offset) - 1
+        vlen -= mod
+    else:
+        vlen += 7 - mod                 # pad to whole trailing groups
+    if vlen > 0:
+        first |= 1 << (delta - 1)       # continue bit
+    out.append(first)
+    if vlen > 0:
+        ngroups = vlen // 7
+        for shift in range(7 * (ngroups - 1), 6, -7):
+            out.append((value >> shift) & _MASK)
+        out.append((value & _MASK) | _STOP)
 
 
 def read_positive_with_prefix(buf, pos: int, prefix_bit_len: int) -> tuple[int, int, int]:
     """Returns (value, prefix, new_pos)."""
-    start = pos
-    combined = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        combined = (combined << 7) | (b & _MASK)
-        if b & _STOP:
-            break
-    ngroups = pos - start
-    payload_bits = 7 * ngroups - prefix_bit_len
-    prefix = combined >> payload_bits
-    value = combined & ((1 << payload_bits) - 1)
+    delta = 8 - prefix_bit_len
+    first = buf[pos]
+    pos += 1
+    prefix = first >> delta
+    value = first & ((1 << (delta - 1)) - 1)
+    if (first >> (delta - 1)) & 1:      # continue bit
+        start = pos
+        rest, pos = read_positive(buf, pos)
+        ngroups = pos - start
+        value = (value << (7 * ngroups)) | rest
     return value, prefix, pos
+
+
+def prefixed_length(value: int, prefix_bit_len: int) -> int:
+    out = bytearray()
+    write_positive_with_prefix(out, value, 0, prefix_bit_len)
+    return len(out)
 
 
 # ---------------------------------------------------------------------------
