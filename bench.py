@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Benchmark: Graph500-style BFS TEPS on the TPU OLAP engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured TEPS / 1e9 (the BASELINE.md target: >= 1B TEPS on
+Graph500 scale-26 BFS on a v5e-8; this runs single-chip at a scale sized to
+the device, so vs_baseline is the fraction of the full multi-chip target
+achieved on one chip).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else (23 if on_accel else 16)
+    edge_factor = 16
+
+    from titan_tpu.models.bfs import BFS, INF
+    from titan_tpu.olap.tpu.engine import TPUGraphComputer
+    from titan_tpu.olap.tpu.rmat import rmat_edges
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+
+    t0 = time.time()
+    src, dst = rmat_edges(scale, edge_factor, seed=2)
+    n = 1 << scale
+    # Graph500 BFS runs on the symmetrized graph
+    s2 = np.concatenate([src, dst])
+    d2 = np.concatenate([dst, src])
+    snap = snap_mod.from_arrays(n, s2, d2)
+    gen_s = time.time() - t0
+
+    comp = TPUGraphComputer(snapshot=snap, num_devices=1)
+    # pick a source with out-degree > 0 (Graph500 rule)
+    deg = snap.out_degree
+    source = int(np.flatnonzero(deg > 0)[0])
+
+    prog = BFS(max_iterations=64)
+    params = {"source_dense": source}
+    # warm-up / compile + converged run
+    t1 = time.time()
+    res = comp.run(prog, params=params, snapshot=snap)
+    first_s = time.time() - t1
+    iters = res.iterations
+
+    # timed runs (compile cached)
+    times = []
+    for _ in range(3):
+        t2 = time.time()
+        res = comp.run(prog, params=params, snapshot=snap)
+        times.append(time.time() - t2)
+    t_bfs = min(times)
+
+    dist = res["dist"]
+    reachable = dist < int(INF)
+    # Graph500 TEPS: input (undirected) edges with both endpoints reachable
+    m_traversed = int(np.count_nonzero(reachable[s2]) // 2)
+    teps = m_traversed / t_bfs
+
+    print(json.dumps({
+        "metric": f"graph500_scale{scale}_bfs_teps",
+        "value": round(teps, 1),
+        "unit": "TEPS",
+        "vs_baseline": round(teps / 1e9, 4),
+        "detail": {
+            "platform": platform,
+            "n_vertices": n,
+            "n_directed_edges": int(len(s2)),
+            "bfs_supersteps": int(iters),
+            "reachable_vertices": int(np.count_nonzero(reachable)),
+            "bfs_seconds": round(t_bfs, 4),
+            "first_run_seconds": round(first_s, 2),
+            "graphgen_seconds": round(gen_s, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,13 +1,18 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU mesh BEFORE jax initializes, so
-multi-chip sharding paths (parallel/, olap/tpu/) are exercised without TPU
-hardware — the same trick the driver's dryrun uses.
+Force JAX onto a virtual 8-device CPU mesh so multi-chip sharding paths
+(parallel/, olap/tpu/) are exercised without TPU hardware — the same trick
+the driver's dryrun uses. NOTE: this environment's sitecustomize registers
+an ``axon`` TPU backend and overrides JAX_PLATFORMS, so the env var alone is
+not enough — the config update after import is what actually pins CPU.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

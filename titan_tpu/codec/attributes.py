@@ -248,6 +248,10 @@ class Serializer:
 
     # -- order-preserving values (schema-typed, raw payload) -----------------
 
+    def orderable(self, py_type: type) -> bool:
+        h = self._by_type.get(py_type)
+        return h is not None and h.orderable
+
     def write_ordered(self, out: DataOutput, value: Any, py_type: type) -> None:
         h = self._by_type.get(py_type) or self.handler_for(value)
         if not h.orderable:
@@ -256,6 +260,8 @@ class Serializer:
 
     def read_ordered(self, buf: ReadBuffer, py_type: type) -> Any:
         h = self._by_type[py_type]
+        if not h.orderable:
+            raise TypeError(f"{py_type.__name__} has no order-preserving codec")
         return h.read_ordered(buf)
 
     def ordered_bytes(self, value: Any, py_type: Optional[type] = None) -> bytes:

@@ -104,11 +104,13 @@ class EdgeCodec:
         return Entry(col.getvalue(), val.getvalue())
 
     def _write_set_value(self, out: DataOutput, value: Any, dtype: type):
-        try:
+        # deterministic by declared dtype (write and read must agree):
+        # orderable dtypes use the order-preserving codec, others the
+        # self-describing one; uniqueness holds either way (same value →
+        # same bytes)
+        if self.serializer.orderable(dtype):
             self.serializer.write_ordered(out, value, dtype)
-        except TypeError:
-            # non-orderable types fall back to the self-describing codec;
-            # uniqueness still holds (same value → same bytes)
+        else:
             self.serializer.write_value(out, value)
 
     # -- edges ---------------------------------------------------------------
@@ -179,9 +181,9 @@ class EdgeCodec:
         elif card is Cardinality.SET:
             relation_id = val.get_uvar_backward_from_end()
             dtype = inspector.data_type(key_id)
-            try:
+            if self.serializer.orderable(dtype):
                 value = self.serializer.read_ordered(col, dtype)
-            except (KeyError, TypeError):
+            else:
                 value = self.serializer.read_value(col)
         else:  # LIST
             relation_id = col.get_uvar()

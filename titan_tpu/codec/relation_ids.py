@@ -20,6 +20,8 @@ so the column prefix stays short; direction bounds for a whole type come from
 
 from __future__ import annotations
 
+from typing import Optional
+
 from titan_tpu.codec.dataio import DataOutput, ReadBuffer
 from titan_tpu.core.defs import Direction, RelationCategory
 from titan_tpu.ids import IDManager, IDType
@@ -78,13 +80,15 @@ def type_prefix(type_id: int, idm: IDManager, category: RelationCategory,
     return out.getvalue()
 
 
-def _bound_bytes(prefix: int) -> tuple[bytes, bytes]:
+def _bound_bytes(prefix: int) -> tuple[bytes, Optional[bytes]]:
     """[start, end) byte range covering every varint with this 3-bit prefix.
-    The prefix lives in the top bits of byte 0, so one-byte bounds suffice."""
+    The prefix lives in the top bits of byte 0, so one-byte bounds suffice;
+    the max prefix is unbounded above (None) — no finite sentinel can cover
+    arbitrarily long encodings."""
     delta = 8 - PREFIX_BITS
     lo = bytes([prefix << delta])
     if prefix == (1 << PREFIX_BITS) - 1:
-        hi = b"\xff\xff"   # above any first byte
+        hi = None
     else:
         hi = bytes([(prefix + 1) << delta])
     return lo, hi

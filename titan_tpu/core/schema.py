@@ -213,8 +213,7 @@ class SchemaManager:
         for key_id in sort_key:
             if not isinstance(self.get_type(key_id), PropertyKey):
                 raise SchemaViolationError("sort key must be property keys")
-            if self.data_type(key_id) not in (int, float, str, bytes,
-                                              _dt.datetime, bool):
+            if not self.serializer.orderable(self.data_type(key_id)):
                 raise SchemaViolationError("sort key dtype must be orderable")
         sid = self._graph.id_assigner.next_schema_id(IDType.USER_EDGE_LABEL)
         return self._store_type(EdgeLabel(sid, name, multiplicity,

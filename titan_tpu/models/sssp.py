@@ -1,0 +1,53 @@
+"""Single-source shortest paths (weighted) as a DenseProgram.
+
+Parity target: the reference's ShortestDistanceVertexProgram OLAP fixture
+(reference: titan-test olap/ShortestDistanceVertexProgram — Bellman-Ford
+style message-minimum over weighted in-edges until stable)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from titan_tpu.olap.api import DenseProgram
+
+FINF = jnp.float32(3.0e38)
+
+
+class SSSP(DenseProgram):
+    combine = "min"
+
+    def __init__(self, weight_key: str = "weight", max_iterations: int = 1000):
+        self.weight_key = weight_key
+        self.max_iterations = max_iterations
+
+    def edge_keys(self):
+        return (self.weight_key,)
+
+    def init(self, n, params):
+        import numpy as np
+        dist = np.full((n,), float(FINF), dtype=np.float32)
+        dist[int(params["source_dense"])] = 0.0
+        return {"dist": jnp.asarray(dist)}
+
+    def message(self, src_state, edge_data, params):
+        w = edge_data[self.weight_key].astype(jnp.float32)
+        d = src_state["dist"]
+        return jnp.where(d >= FINF, FINF, d + w)
+
+    def apply(self, state, agg, iteration, params):
+        return {"dist": jnp.minimum(state["dist"], agg)}
+
+    def done(self, state, new_state, agg, iteration, params):
+        return jnp.all(new_state["dist"] == state["dist"])
+
+    def outputs(self, state, params):
+        return {"dist": state["dist"]}
+
+
+def run(computer, source, weight_key: str = "weight", snapshot=None,
+        max_iterations: int = 1000):
+    snap = snapshot or computer.snapshot(edge_keys=(weight_key,))
+    from titan_tpu.models.bfs import in_snapshot_ids
+    dense = snap.dense_of(source) if in_snapshot_ids(snap, source) else int(source)
+    prog = SSSP(weight_key, max_iterations)
+    return computer.run(prog, params={"source_dense": dense}, snapshot=snap)

@@ -1,0 +1,20 @@
+from titan_tpu.olap.api import (DenseProgram, Memory, Messenger, ScanJob,
+                                ScanMetrics, VertexProgram, VertexScanJob)
+
+
+def graph_computer(graph, backend: str = "tpu", **kwargs):
+    """``graph.compute()`` dispatch (reference:
+    TitanBlueprintsGraph.compute() graphdb/tinkerpop/TitanBlueprintsGraph.java:143
+    choosing FulgoraGraphComputer; here ``computer.backend`` selects the
+    thread-pool host executor or the TPU superstep engine)."""
+    if backend == "tpu":
+        from titan_tpu.olap.tpu.engine import TPUGraphComputer
+        return TPUGraphComputer(graph, **kwargs)
+    if backend == "host":
+        from titan_tpu.olap.computer import HostGraphComputer
+        return HostGraphComputer(graph, **kwargs)
+    raise ValueError(f"unknown computer backend {backend!r}")
+
+
+__all__ = ["DenseProgram", "Memory", "Messenger", "ScanJob", "ScanMetrics",
+           "VertexProgram", "VertexScanJob", "graph_computer"]

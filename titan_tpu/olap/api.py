@@ -1,0 +1,185 @@
+"""OLAP contracts: scan jobs, vertex programs, memory.
+
+Re-creation of the reference's OLAP seam (reference: titan-core
+diskstorage/keycolumnvalue/scan/ScanJob.java:17-130,
+graphdb/olap/VertexScanJob.java:16, TinkerPop VertexProgram +
+graphdb/olap/computer/FulgoraMemory.java/FulgoraVertexMemory.java):
+
+* ``ScanJob`` — raw row-level job run by the scanner (storage/scan.py):
+  declares the column slices it needs, processes each (key, entries) row.
+* ``VertexScanJob`` — vertex-level job; bridged onto ScanJob by the engine.
+* ``VertexProgram`` — BSP program executed per vertex per superstep with
+  message passing (host computer, olap/computer.py).
+* ``DenseProgram`` — the TPU-native program contract: the whole superstep is
+  expressed as pure jnp transforms over dense per-vertex state plus a
+  gather → per-edge message → segment-combine → apply pipeline, compiled
+  once and iterated under ``lax.while_loop`` (olap/tpu/engine.py). This is
+  the redesign of FulgoraGraphComputer's scan loop as batched SpMV.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+
+class ScanMetrics:
+    """(reference: scan/ScanMetrics.java) simple thread-safe counters."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def increment(self, metric: str, delta: int = 1):
+        with self._lock:
+            self._counts[metric] = self._counts.get(metric, 0) + delta
+
+    def get(self, metric: str) -> int:
+        with self._lock:
+            return self._counts.get(metric, 0)
+
+    SUCCESS = "success"
+    FAILURE = "failure"
+
+
+class ScanJob(abc.ABC):
+    def setup(self, graph, config, metrics: ScanMetrics) -> None:
+        pass
+
+    def get_queries(self) -> Sequence:
+        """SliceQuery list; the FIRST is the primary query driving iteration
+        (reference: ScanJob.getQueries)."""
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def process(self, key: bytes, entries_by_query: dict, metrics: ScanMetrics
+                ) -> None:
+        """``entries_by_query``: SliceQuery -> EntryList for this row."""
+
+    def worker_iteration_start(self, config, metrics: ScanMetrics) -> None:
+        pass
+
+    def worker_iteration_end(self, metrics: ScanMetrics) -> None:
+        pass
+
+
+class VertexScanJob(abc.ABC):
+    def setup(self, graph, config, metrics: ScanMetrics) -> None:
+        pass
+
+    @abc.abstractmethod
+    def process(self, vertex, metrics: ScanMetrics) -> None: ...
+
+    def get_queries(self, query_container) -> None:
+        """Declare adjacency slices to preload via the QueryContainer."""
+
+
+class Memory:
+    """Global BSP memory (reference: FulgoraMemory.java:131)."""
+
+    def __init__(self):
+        self._values: dict[str, Any] = {}
+        self.iteration = 0
+
+    def get(self, key: str, default=None):
+        return self._values.get(key, default)
+
+    def set(self, key: str, value):
+        self._values[key] = value
+
+    def add(self, key: str, value):
+        self._values[key] = self._values.get(key, 0) + value
+
+    def keys(self):
+        return list(self._values)
+
+
+class Messenger:
+    """Per-vertex message access during execute()."""
+
+    def __init__(self, vertex_memory, vertex_id: int):
+        self._vm = vertex_memory
+        self._vid = vertex_id
+
+    def receive(self) -> list:
+        return self._vm.messages_for(self._vid)
+
+    def send(self, message, target_ids) -> None:
+        for t in target_ids:
+            self._vm.send(t, message)
+
+
+class VertexProgram(abc.ABC):
+    """Host BSP program (reference: TinkerPop VertexProgram executed by
+    FulgoraGraphComputer.java:151-189)."""
+
+    def setup(self, memory: Memory) -> None:
+        pass
+
+    @abc.abstractmethod
+    def execute(self, vertex, messenger: Messenger, memory: Memory) -> None: ...
+
+    @abc.abstractmethod
+    def terminate(self, memory: Memory) -> bool: ...
+
+    def combiner(self) -> Optional[Callable[[Any, Any], Any]]:
+        """Optional associative message combiner
+        (reference: MessageCombiner)."""
+        return None
+
+    @property
+    def state_keys(self) -> Sequence[str]:
+        """Vertex state property names this program writes."""
+        return ()
+
+
+@dataclass
+class EdgeData:
+    """Per-edge arrays aligned with the snapshot's edge order."""
+    values: dict = field(default_factory=dict)   # name -> np/jnp array [E]
+
+
+class DenseProgram(abc.ABC):
+    """TPU-native vertex program: one compiled superstep, iterated on device.
+
+    State is a dict[str, array] of per-vertex arrays. Each superstep the
+    engine computes::
+
+        src_state = {k: state[k][src] for k}            # gather over edges
+        msg       = self.message(src_state, edge_data)  # [E] per-edge values
+        agg       = segment_<combine>(msg, dst, n)      # combine per vertex
+        state'    = self.apply(state, agg, iteration)
+
+    and stops when ``self.done(state, state', agg, iteration)`` is True or
+    ``max_iterations`` is reached. All callbacks must be jax-traceable.
+    """
+
+    combine: str = "sum"          # 'sum' | 'min' | 'max'
+    max_iterations: int = 50
+
+    @abc.abstractmethod
+    def init(self, n: int, params: dict) -> dict: ...
+
+    @abc.abstractmethod
+    def message(self, src_state: dict, edge_data: dict, params: dict): ...
+
+    @abc.abstractmethod
+    def apply(self, state: dict, agg, iteration, params: dict) -> dict: ...
+
+    def identity(self, params: dict):
+        import jax.numpy as jnp
+        return {"sum": 0.0, "min": jnp.inf, "max": -jnp.inf}[self.combine]
+
+    def done(self, state: dict, new_state: dict, agg, iteration, params: dict):
+        import jax.numpy as jnp
+        return jnp.array(False)
+
+    def edge_keys(self) -> Sequence[str]:
+        """Edge property names required in EdgeData (e.g. ('weight',))."""
+        return ()
+
+    def outputs(self, state: dict, params: dict) -> dict:
+        """Final state → user-facing arrays (default: identity)."""
+        return state

@@ -1,0 +1,166 @@
+"""Host BSP graph computer: thread-pool scan execution of VertexPrograms.
+
+(reference: titan-core graphdb/olap/computer/FulgoraGraphComputer.java:48-401
+— per-iteration scan over all vertices executing the program, message
+exchange through an in-heap vertex memory with optional combiners, loop until
+``terminate``, then write mutated vertex state back in batched transactions.
+This is the generality fallback; DensePrograms take the TPU engine.)
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from titan_tpu.olap.api import Memory, Messenger, ScanMetrics, VertexProgram
+
+
+class VertexMemory:
+    """(reference: FulgoraVertexMemory.java:24-120) per-vertex message
+    buckets with optional combiner, double-buffered across supersteps."""
+
+    def __init__(self, combiner=None):
+        self._combiner = combiner
+        self._incoming: dict[int, list] = {}
+        self._outgoing: dict[int, list] = {}
+        self._state: dict[int, dict] = {}
+        self._lock = threading.Lock()
+
+    def send(self, target: int, message) -> None:
+        with self._lock:
+            if self._combiner is not None:
+                cur = self._outgoing.get(target)
+                if cur is None:
+                    self._outgoing[target] = [message]
+                else:
+                    cur[0] = self._combiner(cur[0], message)
+            else:
+                self._outgoing.setdefault(target, []).append(message)
+
+    def messages_for(self, vid: int) -> list:
+        return self._incoming.get(vid, [])
+
+    def complete_iteration(self) -> None:
+        self._incoming = self._outgoing
+        self._outgoing = {}
+
+    def get_state(self, vid: int) -> dict:
+        st = self._state.get(vid)
+        if st is None:
+            st = {}
+            with self._lock:
+                self._state.setdefault(vid, st)
+                st = self._state[vid]
+        return st
+
+    def all_states(self) -> dict:
+        return self._state
+
+
+class ComputerVertex:
+    """Vertex view handed to programs: adjacency from the tx + a mutable
+    compute-state dict (reference: PreloadedVertex)."""
+
+    __slots__ = ("_v", "_vm")
+
+    def __init__(self, v, vm: VertexMemory):
+        self._v = v
+        self._vm = vm
+
+    @property
+    def id(self):
+        return self._v.id
+
+    def label(self):
+        return self._v.label()
+
+    def value(self, key, default=None):
+        return self._v.value(key, default)
+
+    def edges(self, direction, *labels):
+        return self._v.edges(direction, *labels)
+
+    def vertices(self, direction, *labels):
+        return self._v.vertices(direction, *labels)
+
+    def out(self, *labels):
+        return self._v.out(*labels)
+
+    def in_(self, *labels):
+        return self._v.in_(*labels)
+
+    def both(self, *labels):
+        return self._v.both(*labels)
+
+    def degree(self, direction, *labels):
+        return self._v.degree(direction, *labels)
+
+    # compute-scoped state
+    def set_state(self, key, value):
+        self._vm.get_state(self._v.id)[key] = value
+
+    def get_state(self, key, default=None):
+        return self._vm.get_state(self._v.id).get(key, default)
+
+
+class HostComputerResult:
+    def __init__(self, memory: Memory, states: dict, iterations: int):
+        self.memory = memory
+        self.states = states
+        self.iterations = iterations
+
+    def state_of(self, vid: int) -> dict:
+        return self.states.get(vid, {})
+
+
+class HostGraphComputer:
+    def __init__(self, graph, num_threads: int = 0):
+        self.graph = graph
+        import os
+        self.num_threads = num_threads or min(32, (os.cpu_count() or 4))
+
+    def run(self, program: VertexProgram, max_iterations: int = 100,
+            write_back: bool = False) -> HostComputerResult:
+        memory = Memory()
+        vm = VertexMemory(program.combiner())
+        program.setup(memory)
+        iterations = 0
+        while True:
+            memory.iteration = iterations
+            tx = self.graph.new_transaction(read_only=True)
+            try:
+                vertices = [ComputerVertex(v, vm) for v in tx.vertices()]
+                with ThreadPoolExecutor(max_workers=self.num_threads) as pool:
+                    list(pool.map(
+                        lambda cv: program.execute(
+                            cv, Messenger(vm, cv.id), memory), vertices))
+            finally:
+                tx.rollback()
+            vm.complete_iteration()
+            iterations += 1
+            if program.terminate(memory) or iterations >= max_iterations:
+                break
+        if write_back and program.state_keys:
+            self._write_back(program, vm)
+        return HostComputerResult(memory, vm.all_states(), iterations)
+
+    def _write_back(self, program: VertexProgram, vm: VertexMemory,
+                    batch: int = 5000) -> None:
+        """Persist program state as vertex properties in batched txs
+        (reference: FulgoraGraphComputer.java:248-305)."""
+        items = list(vm.all_states().items())
+        for i in range(0, len(items), batch):
+            tx = self.graph.new_transaction()
+            try:
+                for vid, state in items[i:i + batch]:
+                    v = tx.vertex(vid)
+                    if v is None:
+                        continue
+                    for key in program.state_keys:
+                        if key in state:
+                            v.property(key, state[key])
+                tx.commit()
+            except BaseException:
+                tx.rollback()
+                raise

@@ -1,0 +1,175 @@
+"""CSR snapshot: bulk-export the edgestore into dense device-ready arrays.
+
+This is the seam the reference fills with ScanJob + StandardScannerExecutor
+(reference: titan-core diskstorage/keycolumnvalue/scan/
+StandardScannerExecutor.java:85-188 feeding FulgoraGraphComputer) — redesigned
+for the TPU: instead of streaming rows through per-vertex Java callbacks, one
+ordered scan decodes the adjacency into numpy arrays, vertices are densified
+to [0, n) (key order is partition-major, so dense index ranges are exactly
+the storage partitions), and edges are sorted by destination for pull-mode
+segment reduction on the MXU-adjacent vector units.
+
+The decode hot loop uses the C++ codec when built (native/), else a Python
+loop (correct, slower — fine for OLTP-scale graphs; synthetic benchmarks
+construct snapshots directly from arrays).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from titan_tpu.codec import relation_ids as rids
+from titan_tpu.core.defs import Direction, RelationCategory
+from titan_tpu.storage.api import SliceQuery
+
+
+@dataclass
+class GraphSnapshot:
+    """Dense read-only graph image.
+
+    Edges are stored dst-sorted (``dst`` ascending, the pull layout);
+    ``indptr_in`` indexes them per destination. ``out_degree`` supports
+    degree-normalized programs (PageRank).
+    """
+
+    n: int
+    vertex_ids: np.ndarray          # [n] int64, original ids, ascending key order
+    src: np.ndarray                 # [E] int32 dense indices, dst-sorted
+    dst: np.ndarray                 # [E] int32 dense indices, ascending
+    indptr_in: np.ndarray           # [n+1] int64
+    out_degree: np.ndarray          # [n] int32
+    edge_values: dict = field(default_factory=dict)  # name -> [E] array
+    labels: Optional[np.ndarray] = None              # [E] int32 label codes
+    label_names: dict = field(default_factory=dict)  # code -> label name
+
+    @property
+    def num_edges(self) -> int:
+        return len(self.src)
+
+    def dense_of(self, vertex_id: int) -> int:
+        i = int(np.searchsorted(self.vertex_ids, vertex_id))
+        if i >= self.n or self.vertex_ids[i] != vertex_id:
+            raise KeyError(f"vertex {vertex_id} not in snapshot")
+        return i
+
+    def reverse(self) -> "GraphSnapshot":
+        """Swap edge direction (push layout / in-degree programs)."""
+        return from_arrays(self.n, self.dst, self.src, self.vertex_ids,
+                           edge_values=self.edge_values, labels=self.labels,
+                           label_names=self.label_names)
+
+
+def from_arrays(n: int, src, dst, vertex_ids=None, edge_values=None,
+                labels=None, label_names=None) -> GraphSnapshot:
+    """Build a snapshot from raw (src, dst) dense-index arrays."""
+    src = np.asarray(src, dtype=np.int32)
+    dst = np.asarray(dst, dtype=np.int32)
+    if vertex_ids is None:
+        vertex_ids = np.arange(n, dtype=np.int64)
+    order = np.argsort(dst, kind="stable")
+    src_s, dst_s = src[order], dst[order]
+    ev = {k: np.asarray(v)[order] for k, v in (edge_values or {}).items()}
+    lab = np.asarray(labels, dtype=np.int32)[order] if labels is not None else None
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.add.at(indptr, dst_s + 1, 1)
+    np.cumsum(indptr, out=indptr)
+    out_degree = np.zeros(n, dtype=np.int32)
+    np.add.at(out_degree, src, 1)
+    return GraphSnapshot(n, np.asarray(vertex_ids, dtype=np.int64), src_s,
+                         dst_s, indptr, out_degree, ev, lab,
+                         dict(label_names or {}))
+
+
+def build(graph, labels: Optional[Sequence[str]] = None,
+          edge_keys: Sequence[str] = (),
+          directed: bool = True) -> GraphSnapshot:
+    """Scan the edgestore and build the snapshot.
+
+    ``labels``: restrict to these edge labels (None = all user labels).
+    ``edge_keys``: edge property names to extract into aligned arrays.
+    ``directed=False`` adds the reverse of every edge (symmetrize).
+    """
+    idm = graph.idm
+    schema = graph.schema
+    codec = graph.codec
+    label_ids = None
+    if labels is not None:
+        label_ids = {st.id for name in labels
+                     if (st := schema.get_by_name(name)) is not None}
+    key_ids = {}
+    for name in edge_keys:
+        st = schema.get_by_name(name)
+        if st is not None:
+            key_ids[st.id] = name
+
+    lo, hi = rids.category_bounds(RelationCategory.EDGE, Direction.OUT,
+                                  include_system=False)
+    scan_q = SliceQuery(lo, hi)
+
+    srcs: list[int] = []
+    dsts: list[int] = []
+    labs: list[int] = []
+    ev: dict[str, list] = {name: [] for name in key_ids.values()}
+    vertex_id_list: list[int] = []
+
+    btx = graph.backend.begin_transaction()
+    try:
+        exists_q = codec.query_type(schema.system.vertex_exists, Direction.OUT,
+                                    schema)[0]
+        for key, entries in graph.backend.edge_store.store.get_keys(
+                SliceQuery(), btx.store_tx):
+            vid = idm.id_of_key_bytes(key)
+            if not idm.is_user_vertex_id(vid):
+                continue
+            has_exist = False
+            for e in entries:
+                if exists_q.contains(e.column):
+                    has_exist = True
+                elif scan_q.contains(e.column):
+                    rc = codec.parse(e, schema)
+                    if rc.direction is not Direction.OUT or not rc.is_edge:
+                        continue
+                    if schema.system.is_system(rc.type_id):
+                        continue
+                    if label_ids is not None and rc.type_id not in label_ids:
+                        continue
+                    srcs.append(vid)
+                    dsts.append(rc.other_vertex_id)
+                    labs.append(idm.count(rc.type_id))
+                    for kid, name in key_ids.items():
+                        ev[name].append(rc.properties.get(kid, 0))
+            if has_exist:
+                vertex_id_list.append(vid)
+    finally:
+        btx.commit()
+
+    vertex_ids = np.array(sorted(vertex_id_list), dtype=np.int64)
+    n = len(vertex_ids)
+    raw_src = np.array(srcs, dtype=np.int64)
+    raw_dst = np.array(dsts, dtype=np.int64)
+    # drop edges whose endpoint is missing (ghosts)
+    si = np.searchsorted(vertex_ids, raw_src)
+    di = np.searchsorted(vertex_ids, raw_dst)
+    si = np.clip(si, 0, max(n - 1, 0))
+    di = np.clip(di, 0, max(n - 1, 0))
+    ok = np.ones(len(raw_src), dtype=bool)
+    if n:
+        ok = (vertex_ids[si] == raw_src) & (vertex_ids[di] == raw_dst)
+    src = si[ok].astype(np.int32)
+    dst = di[ok].astype(np.int32)
+    labs_arr = np.array(labs, dtype=np.int32)[ok]
+    evs = {name: np.array(vals)[ok] for name, vals in ev.items()}
+    if not directed:
+        src, dst = np.concatenate([src, dst]), np.concatenate([dst, src])
+        labs_arr = np.concatenate([labs_arr, labs_arr])
+        evs = {name: np.concatenate([v, v]) for name, v in evs.items()}
+    label_names = {}
+    for code in np.unique(labs_arr).tolist() if len(labs_arr) else []:
+        from titan_tpu.ids import IDType
+        st = schema.get_type(idm.schema_id(IDType.USER_EDGE_LABEL, code))
+        if st is not None:
+            label_names[code] = st.name
+    return from_arrays(n, src, dst, vertex_ids, evs, labs_arr, label_names)

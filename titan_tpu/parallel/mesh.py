@@ -1,0 +1,38 @@
+"""Device-mesh helpers.
+
+The OLAP engine shards per-vertex state over a 1D mesh axis ``"v"`` (vertex
+blocks); frontier/state exchange rides ICI via ``all_gather`` inside
+``shard_map`` (SURVEY §2.8: the TPU-native replacement for the reference's
+storage-mediated data movement).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+VERTEX_AXIS = "v"
+
+
+def vertex_mesh(num_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    if num_devices is None or num_devices <= 0:
+        num_devices = len(devs)
+    if num_devices > len(devs):
+        raise ValueError(f"requested {num_devices} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:num_devices]), (VERTEX_AXIS,))
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(VERTEX_AXIS))
+
+
+def edge_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(VERTEX_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -77,10 +77,15 @@ class VertexCentricQueryBuilder:
         if self._vid not in tx._new_vertices and label_ids is not None:
             for lid in label_ids:
                 sort_start, sort_end = self._sort_key_bounds(lid)
+                # the interval is server-side iff it was folded into the slice
+                interval_pushed = sort_start is not None or self._interval is None
                 for q in tx.codec.query_type(lid, self._direction, tx.schema,
                                              sort_start=sort_start,
                                              sort_end=sort_end):
-                    if self._limit is not None:
+                    # only push the limit down when no client-side filter can
+                    # reject rows (else the slice under-returns)
+                    if self._limit is not None and not self._filters and \
+                            interval_pushed:
                         q = q.with_limit(self._limit)
                     for entry in tx.backend_tx.edge_store_query(
                             KeySliceQuery(tx.idm.key_bytes(self._vid), q)):

@@ -31,17 +31,18 @@ _BATCH = 512
 
 
 class Traverser:
-    __slots__ = ("obj", "path", "labels", "sack")
+    __slots__ = ("obj", "prev", "path", "labels", "sack")
 
-    def __init__(self, obj, path=None, labels=None):
+    def __init__(self, obj, path=None, labels=None, prev=None):
         self.obj = obj
-        self.path = path or []
+        self.prev = prev      # object at the previous step (for otherV)
+        self.path = path if path is not None else [obj]
         self.labels = labels or {}
 
     def extend(self, obj, step_label=None, with_path=False):
         t = Traverser(obj,
                       (self.path + [obj]) if with_path else self.path,
-                      self.labels)
+                      self.labels, prev=self.obj)
         if step_label:
             t.labels = dict(self.labels)
             t.labels[step_label] = obj
@@ -267,9 +268,7 @@ class Traversal:
         return folded
 
     def _apply_sub(self, tx, traversers, sub: "Traversal"):
-        out = []
-        ts = list(traversers)
-        stream: Iterable = ts
+        stream: Iterable = traversers
         for name, args in sub._steps:
             stream = self._apply(tx, stream, name, args)
         return stream
@@ -309,7 +308,7 @@ class Traversal:
                     elif mode == "in":
                         yield t.extend(e.in_vertex(), with_path=self._path_needed)
                     else:
-                        prev = t.path[-2] if len(t.path) >= 2 else None
+                        prev = t.prev if isinstance(t.prev, Vertex) else None
                         yield t.extend(e.other(prev) if prev is not None
                                        else e.in_vertex(),
                                        with_path=self._path_needed)
