@@ -1,0 +1,357 @@
+"""Coordination-tier tests: id authority, locking, log bus, WAL recovery,
+global config, instance registry, ghost removal.
+
+Modeled on the reference suites: IDAuthorityTest, LockKeyColumnValueStoreTest,
+ExpectedValueCheckingTest, KCVSLogTest, TitanEventualGraphTest scenarios."""
+
+import threading
+import time
+
+import pytest
+
+import titan_tpu
+from titan_tpu.errors import (PermanentLockingError, TemporaryLockingError,
+                              TitanError)
+from titan_tpu.ids.authority import ConsistentKeyIDAuthority
+from titan_tpu.storage.api import Entry, KeySliceQuery, SliceQuery
+from titan_tpu.storage.inmemory import InMemoryStoreManager
+from titan_tpu.storage.locking import (ConsistentKeyLocker, LocalLockMediator,
+                                       LockID, LockState)
+from titan_tpu.storage.log import KCVSLog, LogManager, ReadMarker
+from titan_tpu.utils.times import MicroProvider, SequenceClock
+
+
+# ---------------------------------------------------------------------------
+# id authority
+# ---------------------------------------------------------------------------
+
+class TestIDAuthority:
+    def test_blocks_unique_and_contiguous(self):
+        m = InMemoryStoreManager()
+        store = m.open_database("system_ids")
+        auth = ConsistentKeyIDAuthority(store, m, b"u1", MicroProvider(),
+                                        wait_ms=1)
+        blocks = [auth.get_id_block(b"p0", 100) for _ in range(5)]
+        for i, b in enumerate(blocks):
+            assert len(b) == 100
+            if i:
+                assert b.start == blocks[i - 1].end  # contiguous
+        # separate namespace starts fresh
+        other = auth.get_id_block(b"p1", 50)
+        assert other.start == 1
+
+    def test_concurrent_claims_never_overlap(self):
+        m = InMemoryStoreManager()
+        store = m.open_database("system_ids")
+        results = []
+        lock = threading.Lock()
+
+        def worker(uid):
+            auth = ConsistentKeyIDAuthority(store, m, uid, MicroProvider(),
+                                            wait_ms=2)
+            got = [auth.get_id_block(b"p0", 20, timeout_s=30) for _ in range(5)]
+            with lock:
+                results.extend(got)
+
+        threads = [threading.Thread(target=worker, args=(b"u%d" % i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 20
+        claimed = set()
+        for b in results:
+            ids = set(range(b.start, b.end))
+            assert not (ids & claimed), "overlapping id blocks!"
+            claimed |= ids
+
+
+# ---------------------------------------------------------------------------
+# locking
+# ---------------------------------------------------------------------------
+
+def make_locker(m, rid=b"r1", group="g1", **kw):
+    store = m.open_database("system_locks")
+    return ConsistentKeyLocker(store, m, rid, MicroProvider(), wait_ms=1,
+                               mediator=LocalLockMediator.instance(group), **kw)
+
+
+class TestLocking:
+    def test_acquire_check_release(self):
+        m = InMemoryStoreManager()
+        locker = make_locker(m, group="t1")
+        st = LockState()
+        lid = LockID("edgestore", b"k", b"c")
+        st.expected[lid] = None
+        locker.write_lock(lid, st)
+        assert st.has_locks
+        locker.check_locks(st, lambda l: None)  # value still absent: ok
+        locker.release_locks(st)
+        assert not st.has_locks
+
+    def test_local_mediation_blocks_second_tx(self):
+        m = InMemoryStoreManager()
+        locker = make_locker(m, group="t2")
+        st1, st2 = LockState(), LockState()
+        lid = LockID("edgestore", b"k", b"c")
+        locker.write_lock(lid, st1)
+        with pytest.raises(TemporaryLockingError):
+            locker.write_lock(lid, st2)
+        locker.release_locks(st1)
+        locker.write_lock(lid, st2)  # now free
+        locker.release_locks(st2)
+
+    def test_remote_contention_earliest_wins(self):
+        m = InMemoryStoreManager()
+        # different mediator groups simulate different processes
+        l1 = make_locker(m, rid=b"r1", group="t3a")
+        l2 = make_locker(m, rid=b"r2", group="t3b")
+        st1, st2 = LockState(), LockState()
+        lid = LockID("edgestore", b"k", b"c")
+        l1.write_lock(lid, st1)
+        with pytest.raises(TemporaryLockingError):
+            l2.write_lock(lid, st2)
+        l1.release_locks(st1)
+        l2.write_lock(lid, st2)
+        l2.release_locks(st2)
+
+    def test_expected_value_violation(self):
+        m = InMemoryStoreManager()
+        locker = make_locker(m, group="t4")
+        st = LockState()
+        lid = LockID("edgestore", b"k", b"c")
+        st.expected[lid] = b"old"
+        locker.write_lock(lid, st)
+        with pytest.raises(PermanentLockingError):
+            locker.check_locks(st, lambda l: b"changed")
+        locker.release_locks(st)
+
+    def test_expired_claims_cleaned(self):
+        m = InMemoryStoreManager()
+        locker = make_locker(m, group="t5", expiry_ms=50)
+        st = LockState()
+        locker.write_lock(LockID("edgestore", b"k", b"c"), st)
+        time.sleep(0.1)  # claim expires but is never released
+        assert locker.clean_expired() >= 1
+
+
+class TestGraphLevelLocking:
+    def test_lock_consistency_serializes_single_property(self):
+        g = titan_tpu.open("inmemory")
+        mgmt = g.management()
+        pk = mgmt.make_property_key("bal", int)
+        mgmt.set_consistency(pk, "lock")
+        tx = g.new_transaction()
+        v = tx.add_vertex(bal=10)
+        tx.commit()
+        # two concurrent txs both overwrite: second must fail on the lock
+        tx1 = g.new_transaction()
+        tx2 = g.new_transaction()
+        tx1.vertex(v.id).property("bal", 20)
+        tx2.vertex(v.id).property("bal", 30)
+        tx1.commit()
+        with pytest.raises((TemporaryLockingError, PermanentLockingError)):
+            tx2.commit()
+        tx3 = g.new_transaction()
+        assert tx3.vertex(v.id).value("bal") == 20
+        tx3.rollback()
+        g.close()
+
+
+# ---------------------------------------------------------------------------
+# log bus
+# ---------------------------------------------------------------------------
+
+class TestLogBus:
+    def test_write_read_roundtrip(self):
+        m = InMemoryStoreManager()
+        lm = LogManager(m, "logstore", b"r1", MicroProvider(),
+                        read_interval_ms=20)
+        log = lm.open_log("test")
+        received = []
+        log.register_reader(ReadMarker.from_time(0),
+                            lambda msg: received.append(msg.content))
+        for i in range(10):
+            log.add(b"msg%d" % i)
+        deadline = time.time() + 5
+        while len(received) < 10 and time.time() < deadline:
+            time.sleep(0.02)
+        assert sorted(received) == [b"msg%d" % i for i in range(10)]
+        lm.close()
+
+    def test_read_marker_resume(self):
+        m = InMemoryStoreManager()
+        lm = LogManager(m, "logstore", b"r1", MicroProvider(),
+                        read_interval_ms=20)
+        log = lm.open_log("resume")
+        got1 = []
+        log.register_reader(ReadMarker.from_identifier("c1", 0),
+                            lambda msg: got1.append(msg.content))
+        log.add(b"a")
+        deadline = time.time() + 5
+        while not got1 and time.time() < deadline:
+            time.sleep(0.02)
+        lm.close()
+        # "restart": a new reader with the same identifier resumes PAST a
+        log2mgr = LogManager(m, "logstore", b"r1", MicroProvider(),
+                             read_interval_ms=20)
+        log2 = log2mgr.open_log("resume")
+        got2 = []
+        log2.register_reader(ReadMarker.from_identifier("c1", 0),
+                             lambda msg: got2.append(msg.content))
+        log2.add(b"b")
+        deadline = time.time() + 5
+        while not got2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert got2 == [b"b"]  # did not re-deliver a
+        log2mgr.close()
+
+    def test_multiple_buckets(self):
+        m = InMemoryStoreManager()
+        lm = LogManager(m, "logstore", b"r1", MicroProvider(),
+                        read_interval_ms=20, num_buckets=3)
+        log = lm.open_log("buckets")
+        received = []
+        log.register_reader(ReadMarker.from_time(0),
+                            lambda msg: received.append(msg.content))
+        for i in range(9):
+            log.add(b"m%d" % i)
+        deadline = time.time() + 5
+        while len(received) < 9 and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(received) == 9
+        lm.close()
+
+
+# ---------------------------------------------------------------------------
+# WAL + recovery
+# ---------------------------------------------------------------------------
+
+class TestWAL:
+    def test_commit_writes_wal_records(self):
+        g = titan_tpu.open({"storage.backend": "inmemory", "tx.log-tx": "true"})
+        from titan_tpu.core.wal import (PRECOMMIT, PRIMARY_SUCCESS,
+                                        SECONDARY_SUCCESS, TransactionLog)
+        tx = g.new_transaction()
+        tx.add_vertex(name="walled")
+        tx.commit()
+        g._wal._log.flush()
+        records = []
+        wal = g._wal
+        log = wal._log
+        log.register_reader(ReadMarker.from_time(0),
+                            lambda m: records.append(wal.parse(m)))
+        deadline = time.time() + 5
+        while len(records) < 3 and time.time() < deadline:
+            time.sleep(0.02)
+        statuses = [s for _, s, _ in records]
+        assert statuses == [PRECOMMIT, PRIMARY_SUCCESS, SECONDARY_SUCCESS]
+        txids = {t for t, _, _ in records}
+        assert len(txids) == 1
+        # precommit payload carries the mutations
+        payload = records[0][2]
+        assert "edgestore" in payload and payload["edgestore"]
+        g.close()
+
+    def test_recovery_replays_lost_secondary(self):
+        g = titan_tpu.open({"storage.backend": "inmemory", "tx.log-tx": "true"})
+        from titan_tpu.core import wal as wal_mod
+        wal = g._wal
+        txid = wal.next_txid()
+        # simulate: primary committed, secondary (graphindex) writes lost
+        lost = {"graphindex": {b"idxkey": ([[b"col", b"val"]], [])}}
+        wal.log_precommit(txid, lost)
+        wal.log_primary_success(txid)
+        wal._log.flush()
+        recovery = wal_mod.TransactionRecovery(g, wal._log, start_time=0,
+                                               persistence_timeout_s=0.05)
+        deadline = time.time() + 5
+        while recovery.recovered < 1 and time.time() < deadline:
+            recovery.force_sweep()
+            time.sleep(0.05)
+        assert recovery.recovered == 1
+        txh = g.backend.manager.begin_transaction()
+        got = g.backend.index_store.store.get_slice(
+            KeySliceQuery(b"idxkey", SliceQuery()), txh)
+        txh.commit()
+        assert got == [Entry(b"col", b"val")]
+        g.close()
+
+
+# ---------------------------------------------------------------------------
+# global config + instances
+# ---------------------------------------------------------------------------
+
+class TestGlobalConfig:
+    def test_global_options_persist_and_win(self, tmp_path):
+        path = str(tmp_path / "db")
+        g = titan_tpu.open({"storage.backend": "sqlite",
+                            "storage.directory": path,
+                            "cluster.max-partitions": 16})
+        assert g.idm.num_partitions == 16
+        g.close()
+        # reopen with a DIFFERENT local value: the stored global (FIXED) wins
+        g2 = titan_tpu.open({"storage.backend": "sqlite",
+                             "storage.directory": path,
+                             "cluster.max-partitions": 64})
+        assert g2.idm.num_partitions == 16
+        g2.close()
+
+    def test_duplicate_instance_id_rejected(self):
+        from titan_tpu.storage.inmemory import InMemoryStoreManager
+        from titan_tpu.storage.backend import Backend
+        m = InMemoryStoreManager()
+        b = Backend(manager=m, instance_id="i-1")
+        b.instance_registry.register("i-1")
+        with pytest.raises(TitanError):
+            b.instance_registry.register("i-1")
+        assert b.instance_registry.instances() == ["i-1"]
+        b.instance_registry.force_evict("i-1")
+        b.instance_registry.register("i-1")  # after eviction: ok
+
+    def test_management_global_option_roundtrip(self):
+        g = titan_tpu.open("inmemory")
+        from titan_tpu.config import defaults as d
+        mgmt = g.management()
+        mgmt.set_global_option(d.LOG_TTL_S, 3600, "mylog")
+        assert mgmt.get_global_option(d.LOG_TTL_S, "mylog") == 3600
+        g.close()
+
+
+# ---------------------------------------------------------------------------
+# ghost removal
+# ---------------------------------------------------------------------------
+
+def test_ghost_vertex_removal():
+    from titan_tpu.olap.jobs import remove_ghost_vertices
+    g = titan_tpu.open("inmemory")
+    tx = g.new_transaction()
+    a = tx.add_vertex(name="alive")
+    ghost = tx.add_vertex(name="ghost")
+    a.add_edge("knows", ghost)
+    tx.commit()
+    # simulate a half-deleted vertex: existence marker gone, relations remain
+    from titan_tpu.core.defs import Direction
+    [q] = g.codec.query_type(g.schema.system.vertex_exists, Direction.OUT,
+                             g.schema)
+    key = g.idm.key_bytes(ghost.id)
+    txh = g.backend.manager.begin_transaction()
+    entries = g.backend.edge_store.store.get_slice(KeySliceQuery(key, q), txh)
+    g.backend.edge_store.store.mutate(key, [], [e.column for e in entries], txh)
+    txh.commit()
+    g.backend.edge_store.invalidate(key)
+
+    removed = remove_ghost_vertices(g)
+    assert removed == 1
+    tx = g.new_transaction()
+    assert tx.vertex(ghost.id) is None
+    # row fully gone
+    txh = g.backend.manager.begin_transaction()
+    left = g.backend.edge_store.store.get_slice(
+        KeySliceQuery(key, SliceQuery()), txh)
+    txh.commit()
+    assert left == []
+    assert tx.vertex(a.id) is not None
+    tx.rollback()
+    g.close()

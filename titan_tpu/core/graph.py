@@ -28,14 +28,47 @@ from titan_tpu.storage.backend import Backend
 
 class StandardGraph:
     def __init__(self, config: Configuration):
-        self.config = config
+        self.local_config = config
         self.instance_id = config.get(d.UNIQUE_INSTANCE_ID) or \
             f"{os.getpid()}-{_uuid.uuid4().hex[:8]}"
+        self.backend = Backend(config, instance_id=self.instance_id)
+
+        # merge cluster-global config stored IN the backend with the local
+        # file: GLOBAL/FIXED options are authoritative from the store;
+        # first opener initializes them from its local values (reference:
+        # GraphDatabaseConfiguration ctor + KCVSConfiguration)
+        from titan_tpu.config import (Configuration as _Cfg,
+                                      MergedConfiguration,
+                                      ModifiableConfiguration, Restriction)
+        global_raw = self.backend.global_config_store
+        if global_raw.get("cluster.frozen") is None:
+            init = ModifiableConfiguration(d.ROOT, global_raw)
+            init.set(d.MAX_PARTITIONS, config.get(d.MAX_PARTITIONS), force=True)
+            init.set(d.TIMESTAMP_PROVIDER, config.get(d.TIMESTAMP_PROVIDER),
+                     force=True)
+            global_raw.set("cluster.frozen", True)
+        self.config = MergedConfiguration(
+            config, _Cfg(d.ROOT, global_raw))
+        config = self.config
+
+        # the backend was built from the LOCAL config; FIXED options from the
+        # global store are authoritative — re-align the timestamp provider
+        # (drives lock claims and log ordering across instances)
+        self.backend.set_timestamp_provider(config.get(d.TIMESTAMP_PROVIDER))
+
+        self.backend.instance_registry.register(self.instance_id)
         self.idm = IDManager(
             partition_bits=(config.get(d.MAX_PARTITIONS)).bit_length() - 1)
-        self.backend = Backend(config, instance_id=self.instance_id)
         self.serializer = Serializer()
         self.codec = EdgeCodec(self.serializer, self.idm)
+
+        # WAL (reference: tx.log-tx → txlog writes in the commit path)
+        self._wal = None
+        if config.get(d.LOG_TX):
+            from titan_tpu.core.wal import TransactionLog
+            self._wal = TransactionLog(
+                self.backend.log_manager.open_log(config.get(d.TX_LOG_NAME)),
+                self.serializer)
         self.id_assigner = IDAssigner(
             self.idm, self.backend.id_authority,
             block_size=config.get(d.IDS_BLOCK_SIZE),
@@ -109,6 +142,8 @@ class StandardGraph:
     def commit_transaction(self, tx: GraphTransaction) -> None:
         additions: dict[bytes, list] = {}
         deletions: dict[bytes, list] = {}
+        # (vertex row, column) -> expected old value, for LOCK-consistency
+        lock_targets: dict[tuple, Optional[bytes]] = {}
 
         def add(vid: int, entry: Entry):
             additions.setdefault(self.idm.key_bytes(vid), []).append(entry)
@@ -120,27 +155,90 @@ class StandardGraph:
         # old entry and writes the new one on the same column — consolidation
         # in the mutator keeps the addition; reference: prepareCommit order)
         for rel in tx._deleted.values():
+            locked = self._needs_lock(rel)
             for vid, entry in self._serialize(rel):
                 delete(vid, entry.column)
+                if locked:
+                    lock_targets[(self.idm.key_bytes(vid), entry.column)] = \
+                        entry.value
         for rel in tx._added.values():
+            locked = self._needs_lock(rel)
             for vid, entry in self._serialize(rel):
                 add(vid, entry)
+                if locked:
+                    lock_targets.setdefault(
+                        (self.idm.key_bytes(vid), entry.column), None)
 
         btx = tx.backend_tx
-        with self._commit_lock:
-            for key in set(additions) | set(deletions):
-                btx.mutate_edges(
-                    key,
-                    additions.get(key, ()),
-                    deletions.get(key, ()))
-            try:
-                btx.commit()
-            except BaseException:
+        locker = self.backend.locker
+        lock_state = tx._lock_state
+        try:
+            if lock_targets and locker is not None:
+                from titan_tpu.storage.locking import LockID
+                for (key, column), expected in lock_targets.items():
+                    lid = LockID("edgestore", key, column)
+                    lock_state.expected.setdefault(lid, expected)
+                    locker.write_lock(lid, lock_state)
+
+            wal, txid = self._wal, None
+            if wal is not None:
+                txid = wal.next_txid()
+                wal.log_precommit(txid, {
+                    "edgestore": {key: ([tuple(e) for e in additions.get(key, [])],
+                                        list(deletions.get(key, [])))
+                                  for key in set(additions) | set(deletions)}})
+
+            with self._commit_lock:
+                if lock_state.has_locks and locker is not None:
+                    locker.check_locks(lock_state, self._read_current_value)
+                for key in set(additions) | set(deletions):
+                    btx.mutate_edges(
+                        key,
+                        additions.get(key, ()),
+                        deletions.get(key, ()))
                 try:
+                    btx.commit_storage()
+                except BaseException:
                     btx.rollback()
-                finally:
-                    pass
+                    raise
+            if wal is not None:
+                wal.log_primary_success(txid)
+            try:
+                btx.commit_indexes()
+                if wal is not None:
+                    wal.log_secondary_success(txid)
+            except BaseException:
+                if wal is not None:
+                    wal.log_secondary_failure(txid)
                 raise
+        finally:
+            # EVERY exit path releases locks — a leak would wedge this
+            # column for every later tx until expiry
+            if locker is not None and lock_state.has_locks:
+                locker.release_locks(lock_state)
+
+    def _needs_lock(self, rel) -> bool:
+        if self.backend.locker is None:
+            return False
+        if self.schema.system.is_system(rel.type_id):
+            return False
+        st = self.schema.get_type(rel.type_id)
+        return st is not None and getattr(st, "consistency", "none") == "lock"
+
+    def _read_current_value(self, lid) -> Optional[bytes]:
+        from titan_tpu.storage.api import KeySliceQuery, SliceQuery
+        from titan_tpu.codec.relation_ids import next_prefix
+        txh = self.backend.manager.begin_transaction()
+        try:
+            entries = self.backend.edge_store.store.get_slice(
+                KeySliceQuery(lid.key, SliceQuery(lid.column,
+                                                  next_prefix(lid.column))), txh)
+        finally:
+            txh.commit()
+        for e in entries:
+            if e.column == lid.column:
+                return e.value
+        return None
 
     def _serialize(self, rel):
         """Yield (vertex_id, Entry) per materialized endpoint row."""
@@ -179,6 +277,10 @@ class StandardGraph:
         if not self._open:
             return
         self._open = False
+        try:
+            self.backend.instance_registry.deregister(self.instance_id)
+        except Exception:
+            pass
         self.id_assigner.close()
         self.backend.close()
 

@@ -58,6 +58,40 @@ class ManagementSystem:
     def contains_vertex_label(self, name: str) -> bool:
         return isinstance(self.schema.get_by_name(name), VertexLabel)
 
+    # -- consistency (reference: TitanManagement.setConsistency) -------------
+
+    def set_consistency(self, schema_type, modifier: str):
+        """``modifier``: 'none' or 'lock' — LOCK types acquire consistent-key
+        locks on their unique columns at commit."""
+        if modifier not in ("none", "lock"):
+            raise ValueError("consistency must be 'none' or 'lock'")
+        import dataclasses
+        updated = dataclasses.replace(schema_type, consistency=modifier)
+        return self.schema.update_type(updated)
+
+    # -- instances (reference: ManagementSystem instance surface) ------------
+
+    def open_instances(self) -> list:
+        return self.graph.backend.instance_registry.instances()
+
+    def force_close_instance(self, instance_id: str) -> None:
+        self.graph.backend.instance_registry.force_evict(instance_id)
+
+    # -- cluster-global options ----------------------------------------------
+
+    def set_global_option(self, option, value, *umbrella) -> None:
+        from titan_tpu.config import ModifiableConfiguration, Restriction, defaults
+        mod = ModifiableConfiguration(defaults.ROOT,
+                                      self.graph.backend.global_config_store,
+                                      Restriction.GLOBAL)
+        mod.set(option, value, *umbrella)
+
+    def get_global_option(self, option, *umbrella):
+        from titan_tpu.config import Configuration, defaults
+        cfg = Configuration(defaults.ROOT,
+                            self.graph.backend.global_config_store)
+        return cfg.get(option, *umbrella)
+
     def commit(self):
         self._open = False
 

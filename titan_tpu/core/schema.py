@@ -71,11 +71,12 @@ class PropertyKey(SchemaType):
     dtype: type = str
     cardinality: Cardinality = Cardinality.SINGLE
     status: SchemaStatus = SchemaStatus.ENABLED
+    consistency: str = "none"   # none | lock (reference: ConsistencyModifier)
 
     def definition(self) -> dict:
         return {"kind": "key", "dtype": _DTYPE_NAMES[self.dtype],
                 "cardinality": self.cardinality.value,
-                "status": self.status.value}
+                "status": self.status.value, "consistency": self.consistency}
 
 
 @dataclass(frozen=True)
@@ -84,11 +85,13 @@ class EdgeLabel(SchemaType):
     unidirected: bool = False
     sort_key: tuple = ()
     status: SchemaStatus = SchemaStatus.ENABLED
+    consistency: str = "none"
 
     def definition(self) -> dict:
         return {"kind": "label", "multiplicity": self.multiplicity.value,
                 "unidirected": self.unidirected,
-                "sort_key": list(self.sort_key), "status": self.status.value}
+                "sort_key": list(self.sort_key), "status": self.status.value,
+                "consistency": self.consistency}
 
 
 @dataclass(frozen=True)
@@ -106,12 +109,14 @@ def _from_definition(schema_id: int, name: str, d: dict) -> SchemaType:
     if kind == "key":
         return PropertyKey(schema_id, name, _DTYPES[d["dtype"]],
                            Cardinality(d["cardinality"]),
-                           SchemaStatus(d.get("status", "enabled")))
+                           SchemaStatus(d.get("status", "enabled")),
+                           d.get("consistency", "none"))
     if kind == "label":
         return EdgeLabel(schema_id, name, Multiplicity(d["multiplicity"]),
                          d.get("unidirected", False),
                          tuple(d.get("sort_key", ())),
-                         SchemaStatus(d.get("status", "enabled")))
+                         SchemaStatus(d.get("status", "enabled")),
+                         d.get("consistency", "none"))
     if kind == "vertexlabel":
         return VertexLabel(schema_id, name, d.get("partitioned", False),
                            d.get("static", False))

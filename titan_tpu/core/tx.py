@@ -50,6 +50,8 @@ class GraphTransaction:
         self._added: dict[int, InternalRelation] = {}        # rel id -> rel
         self._deleted: dict[int, InternalRelation] = {}      # rel id -> rel
         self._added_by_vertex: dict[int, list] = {}          # vid -> [rel]
+        from titan_tpu.storage.locking import LockState
+        self._lock_state = LockState()
 
     # ------------------------------------------------------------------ infra
 
@@ -455,6 +457,9 @@ class GraphTransaction:
                 self._backend_tx.rollback()
         finally:
             self._open = False
+            if self._lock_state.has_locks and \
+                    self.graph.backend.locker is not None:
+                self.graph.backend.locker.release_locks(self._lock_state)
         self._added.clear()
         self._deleted.clear()
         self._added_by_vertex.clear()

@@ -57,26 +57,37 @@ class TPUGraphComputer:
     def __init__(self, graph=None, snapshot: Optional[GraphSnapshot] = None,
                  num_devices: int = 0):
         self.graph = graph
-        self._snapshot = snapshot
+        self._default_snapshot = snapshot
+        self._built: dict[tuple, GraphSnapshot] = {}
         self.num_devices = num_devices
 
     def snapshot(self, labels=None, edge_keys=(), directed=True) -> GraphSnapshot:
-        if self._snapshot is None:
+        """Snapshot for the given parameters; cached PER parameter set (a
+        cached directed snapshot must never answer a symmetrized request)."""
+        default_args = labels is None and not tuple(edge_keys) and directed
+        if self._default_snapshot is not None and default_args:
+            return self._default_snapshot
+        key = (tuple(labels) if labels is not None else None,
+               tuple(edge_keys), directed)
+        snap = self._built.get(key)
+        if snap is None:
             from titan_tpu.olap.tpu import snapshot as snap_mod
             if self.graph is None:
-                raise ValueError("no graph and no snapshot")
-            self._snapshot = snap_mod.build(self.graph, labels=labels,
-                                            edge_keys=edge_keys,
-                                            directed=directed)
-        return self._snapshot
+                raise ValueError(
+                    "computer holds a fixed snapshot but this request needs "
+                    f"different parameters {key}; pass snapshot= explicitly "
+                    "or construct the computer from a graph")
+            snap = snap_mod.build(self.graph, labels=labels,
+                                  edge_keys=edge_keys, directed=directed)
+            self._built[key] = snap
+        return snap
 
     def run(self, program: DenseProgram, params: Optional[dict] = None,
             snapshot: Optional[GraphSnapshot] = None) -> TPUEngineResult:
         snap = snapshot or self.snapshot(edge_keys=program.edge_keys())
         ndev = self.num_devices
-        avail = len(jax.devices())
         if ndev <= 0:
-            ndev = 1 if avail == 1 else avail
+            ndev = len(jax.devices())
         if ndev == 1:
             return run_single(program, snap, params)
         return run_sharded(program, snap, params, vertex_mesh(ndev))
@@ -88,12 +99,15 @@ class TPUGraphComputer:
 
 @partial(jax.jit, static_argnums=(0,), static_argnames=("max_iter", "n"))
 def _iterate_single(program: DenseProgram, state: dict, src, dst, edata: dict,
-                    params: dict, max_iter: int, n: int):
+                    seg_meta: tuple, params: dict, max_iter: int, n: int):
+    last_idx, seg_has = seg_meta
+
     def superstep(carry):
         state, it, _ = carry
         src_state = {k: v[src] for k, v in state.items()}
         msg = program.message(src_state, edata, params)
-        agg = segment_combine(msg, dst, n, program.combine)
+        agg = segment_combine(msg, dst, n, program.combine,
+                              last_idx=last_idx, seg_has=seg_has)
         new_state = program.apply(state, agg, it, params)
         done = program.done(state, new_state, agg, it, params)
         return new_state, it + 1, done
@@ -107,15 +121,29 @@ def _iterate_single(program: DenseProgram, state: dict, src, dst, edata: dict,
     return state, iters
 
 
+def _device_graph_single(snap: GraphSnapshot):
+    """Device-resident edge arrays, uploaded once per snapshot (cached on the
+    snapshot object — repeated runs must not re-pay host→HBM transfer)."""
+    cached = getattr(snap, "_dev_single", None)
+    if cached is None:
+        from titan_tpu.ops.segment import segment_metadata
+        li, sh = segment_metadata(snap.indptr_in)
+        cached = (jnp.asarray(snap.src), jnp.asarray(snap.dst),
+                  {k: jnp.asarray(v) for k, v in snap.edge_values.items()},
+                  (jnp.asarray(li), jnp.asarray(sh)))
+        snap._dev_single = cached
+    return cached
+
+
 def run_single(program: DenseProgram, snap: GraphSnapshot,
                params: Optional[dict] = None) -> TPUEngineResult:
     params = dict(params or {})
     n = snap.n
     state = {k: jnp.asarray(v) for k, v in program.init(n, params).items()}
-    src = jnp.asarray(snap.src)
-    dst = jnp.asarray(snap.dst)
-    edata = {k: jnp.asarray(v) for k, v in snap.edge_values.items()}
-    state, iters = _iterate_single(program, state, src, dst, edata,
+    src, dst, edata, seg_meta = _device_graph_single(snap)
+    edata = {k: edata[k] for k in program.edge_keys()} if program.edge_keys() \
+        else edata
+    state, iters = _iterate_single(program, state, src, dst, edata, seg_meta,
                                    _traceable(params),
                                    max_iter=program.max_iterations, n=n)
     outputs = program.outputs(state, params)
@@ -131,7 +159,14 @@ def run_sharded(program: DenseProgram, snap: GraphSnapshot,
                 params: Optional[dict], mesh: Mesh) -> TPUEngineResult:
     params = dict(params or {})
     ndev = mesh.devices.size
-    sharded = shard_csr(snap, ndev)
+    cache = getattr(snap, "_dev_sharded", None)
+    if cache is None:
+        cache = {}
+        snap._dev_sharded = cache
+    sharded = cache.get(ndev)
+    if sharded is None:
+        sharded = shard_csr(snap, ndev)
+        cache[ndev] = sharded
     return _run_sharded_csr(program, sharded, params, mesh)
 
 
@@ -145,13 +180,16 @@ def _run_sharded_csr(program: DenseProgram, sc: ShardedCSR, params: dict,
     vspec = P(VERTEX_AXIS)
     espec = P(VERTEX_AXIS, None)
 
-    identity = None  # resolved per-msg dtype inside
+    edge_keys = tuple(program.edge_keys())
+    wanted_edata = {k for k in sc.edge_values if not edge_keys or k in edge_keys}
 
-    def per_device(state, src_g, dst_l, valid, edata):
+    def per_device(state, src_g, dst_l, valid, last_idx, seg_has, edata):
         # state arrays come in as [block]; edge arrays as [1, e_block]
         src_g = src_g[0]
         dst_l = dst_l[0]
         valid = valid[0]
+        last_idx = last_idx[0]
+        seg_has = seg_has[0]
         edata = {k: v[0] for k, v in edata.items()}
 
         def superstep(carry):
@@ -162,7 +200,8 @@ def _run_sharded_csr(program: DenseProgram, sc: ShardedCSR, params: dict,
             msg = program.message(src_state, edata, tparams)
             ident = combine_identity(program.combine, msg.dtype)
             msg = jnp.where(valid, msg, ident)
-            agg = segment_combine(msg, dst_l, block + 1, program.combine)[:block]
+            agg = segment_combine(msg, dst_l, block + 1, program.combine,
+                                  last_idx=last_idx, seg_has=seg_has)[:block]
             new_state = program.apply(state, agg, it, tparams)
             local_done = program.done(state, new_state, agg, it, tparams)
             not_done = jax.lax.psum(
@@ -180,16 +219,26 @@ def _run_sharded_csr(program: DenseProgram, sc: ShardedCSR, params: dict,
 
     mapped = jax.jit(jax.shard_map(
         per_device, mesh=mesh,
-        in_specs=({k: vspec for k in state0}, espec, espec, espec,
-                  {k: espec for k in sc.edge_values}),
+        in_specs=({k: vspec for k in state0}, espec, espec, espec, espec,
+                  espec, {k: espec for k in sorted(wanted_edata)}),
         out_specs=({k: vspec for k in state0}, P()),
         check_vma=False))
 
-    src_g = jnp.asarray(sc.src_global)
-    dst_l = jnp.asarray(sc.dst_local)
-    valid = jnp.asarray(sc.valid)
-    edata = {k: jnp.asarray(v) for k, v in sc.edge_values.items()}
-    state, iters = mapped(state0, src_g, dst_l, valid, edata)
+    dev = getattr(sc, "_dev", None)
+    if dev is None:
+        dev = (jnp.asarray(sc.src_global), jnp.asarray(sc.dst_local),
+               jnp.asarray(sc.valid), jnp.asarray(sc.last_idx),
+               jnp.asarray(sc.seg_has), {})
+        sc._dev = dev
+    src_g, dst_l, valid, last_idx_d, seg_has_d, edata_cache = dev
+    # edge properties upload lazily, only the ones this program reads
+    edata = {}
+    for k in sorted(wanted_edata):
+        if k not in edata_cache:
+            edata_cache[k] = jnp.asarray(sc.edge_values[k])
+        edata[k] = edata_cache[k]
+    state, iters = mapped(state0, src_g, dst_l, valid, last_idx_d, seg_has_d,
+                          edata)
     outputs = program.outputs({k: v[:n] for k, v in state.items()}, params)
     return TPUEngineResult({k: np.asarray(v) for k, v in outputs.items()},
                            int(iters), n)

@@ -35,6 +35,8 @@ class ShardedCSR:
     src_global: np.ndarray      # [D, e_block] int32
     dst_local: np.ndarray       # [D, e_block] int32 in [0, block]; block = sink
     valid: np.ndarray           # [D, e_block] bool
+    last_idx: np.ndarray        # [D, block+1] int32 scan metadata (ops/segment)
+    seg_has: np.ndarray         # [D, block+1] bool
     edge_values: dict = field(default_factory=dict)  # name -> [D, e_block]
 
 
@@ -54,8 +56,11 @@ def shard_csr(snap: GraphSnapshot, num_shards: int,
     src_g = np.zeros((num_shards, e_block), dtype=np.int32)
     dst_l = np.full((num_shards, e_block), block, dtype=np.int32)  # sink
     valid = np.zeros((num_shards, e_block), dtype=bool)
+    last_idx = np.zeros((num_shards, block + 1), dtype=np.int32)
+    seg_has = np.zeros((num_shards, block + 1), dtype=bool)
     evs = {name: np.zeros((num_shards, e_block), dtype=np.asarray(v).dtype)
            for name, v in snap.edge_values.items()}
+    from titan_tpu.ops.segment import segment_metadata
     for d in range(num_shards):
         lo, hi = bounds[d], bounds[d + 1]
         m = hi - lo
@@ -64,5 +69,12 @@ def shard_csr(snap: GraphSnapshot, num_shards: int,
         valid[d, :m] = True
         for name, v in snap.edge_values.items():
             evs[name][d, :m] = v[lo:hi]
+        # scan metadata over the local (block+1)-segment layout (sink last)
+        indptr_l = np.zeros(block + 2, dtype=np.int64)
+        np.add.at(indptr_l, dst_l[d] + 1, 1)
+        np.cumsum(indptr_l, out=indptr_l)
+        li, sh = segment_metadata(indptr_l)
+        last_idx[d] = li[:block + 1]
+        seg_has[d] = sh[:block + 1]
     return ShardedCSR(n, n_pad, block, num_shards, e_block, src_g, dst_l,
-                      valid, evs)
+                      valid, last_idx, seg_has, evs)

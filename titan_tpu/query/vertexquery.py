@@ -82,10 +82,11 @@ class VertexCentricQueryBuilder:
                 for q in tx.codec.query_type(lid, self._direction, tx.schema,
                                              sort_start=sort_start,
                                              sort_end=sort_end):
-                    # only push the limit down when no client-side filter can
-                    # reject rows (else the slice under-returns)
+                    # only push the limit down when no client-side check can
+                    # reject rows (filters, unpushed intervals, OR tx-deleted
+                    # relations — all would make the slice under-return)
                     if self._limit is not None and not self._filters and \
-                            interval_pushed:
+                            interval_pushed and not tx._deleted:
                         q = q.with_limit(self._limit)
                     for entry in tx.backend_tx.edge_store_query(
                             KeySliceQuery(tx.idm.key_bytes(self._vid), q)):

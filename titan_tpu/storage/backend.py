@@ -15,6 +15,9 @@ from typing import Optional
 
 from titan_tpu.storage.api import KeyColumnValueStoreManager
 from titan_tpu.storage.cache import ExpirationStoreCache, NoCache, StoreCache
+from titan_tpu.storage.config_store import InstanceRegistry, KCVSConfiguration
+from titan_tpu.storage.locking import ConsistentKeyLocker, LocalLockMediator
+from titan_tpu.storage.log import LogManager
 from titan_tpu.storage.registry import store_manager
 from titan_tpu.storage.tx import BackendTransaction
 from titan_tpu.ids.authority import ConsistentKeyIDAuthority, IDAuthority
@@ -24,8 +27,8 @@ EDGESTORE_NAME = "edgestore"
 INDEXSTORE_NAME = "graphindex"
 ID_STORE_NAME = "system_ids"
 CONFIG_STORE_NAME = "system_properties"
-TXLOG_STORE_NAME = "txlog"
-SYSTEMLOG_STORE_NAME = "systemlog"
+LOCK_STORE_NAME = "system_locks"
+LOG_STORE_NAME = "systemlog_store"
 
 
 class Backend:
@@ -74,11 +77,52 @@ class Backend:
         self._read_attempts = config.get(d.READ_ATTEMPTS) if config else 3
         self._write_attempts = config.get(d.WRITE_ATTEMPTS) if config else 5
         self._wait_ms = config.get(d.STORAGE_ATTEMPT_WAIT_MS) if config else 250
+
+        # cluster-global config + instance registry (reference:
+        # KCVSConfiguration over system_properties, Backend.java:273-298)
+        from titan_tpu.codec.attributes import Serializer as _Ser
+        self.global_config_store = KCVSConfiguration(
+            self.config_store, manager, _Ser())
+        self.instance_registry = InstanceRegistry(self.config_store, manager)
+
+        # consistent-key locking (skipped when the store has native locking
+        # or batch-loading is on; reference: Backend.java:166-171)
+        rid = instance_id.encode("utf-8")
+        batch = bool(config and config.get(d.STORAGE_BATCH))
+        if not manager.features.locking and not batch:
+            group = (config.get(d.LOCK_LOCAL_MEDIATOR_GROUP)
+                     if config else None) or f"{id(manager)}"
+            self.locker = ConsistentKeyLocker(
+                manager.open_database(LOCK_STORE_NAME), manager, rid,
+                self.times,
+                wait_ms=config.get(d.LOCK_WAIT_MS) if config else 100,
+                expiry_ms=config.get(d.LOCK_EXPIRY_MS) if config else 300_000,
+                retries=config.get(d.LOCK_RETRIES) if config else 3,
+                mediator=LocalLockMediator.instance(group))
+        else:
+            self.locker = None
+
+        # log bus (WAL, schema broadcasts, user trigger logs)
+        self.log_manager = LogManager(manager, LOG_STORE_NAME, rid, self.times)
         self._closed = False
 
     @property
     def features(self):
         return self.manager.features
+
+    def set_timestamp_provider(self, name: str) -> None:
+        """Re-align every timestamp consumer after the cluster-global (FIXED)
+        provider is known — lock-claim and log ordering must agree across
+        instances, so the global value overrides the local guess."""
+        times = time_provider(name)
+        if type(times) is type(self.times):
+            return
+        self.times = times
+        if isinstance(self.id_authority, ConsistentKeyIDAuthority):
+            self.id_authority._times = times
+        if self.locker is not None:
+            self.locker._times = times
+        self.log_manager._times = times
 
     def begin_transaction(self, tx_config=None,
                           index_txs: Optional[dict] = None) -> BackendTransaction:
@@ -98,5 +142,6 @@ class Backend:
         if self._closed:
             return
         self._closed = True
+        self.log_manager.close()
         self.id_authority.close()
         self.manager.close()

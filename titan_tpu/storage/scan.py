@@ -61,35 +61,49 @@ class StandardScanner:
                     rows.put(_POISON)
 
         def processor():
-            job.worker_iteration_start(config or {}, metrics)
+            failed = False
             processed = 0
+            try:
+                job.worker_iteration_start(config or {}, metrics)
+            except BaseException as e:
+                errors.append(e)
+                failed = True
             try:
                 while True:
                     item = rows.get()
                     if item is _POISON:
                         break
-                    key, entries = item
-                    by_query = {}
-                    primary_empty = True
-                    for q in queries:
-                        sliced = apply_slice(entries, q)
-                        by_query[q] = sliced
-                        if q is primary and sliced:
-                            primary_empty = False
-                    if primary_empty:
-                        continue  # row lacks the primary query → skip
+                    if failed:
+                        continue  # keep DRAINING so the puller never blocks
                     try:
-                        job.process(key, by_query, metrics)
-                        metrics.increment(ScanMetrics.SUCCESS)
-                    except Exception:
-                        log.exception("scan job failed on row %r", key)
-                        metrics.increment(ScanMetrics.FAILURE)
-                    processed += 1
-                    if processed % block_size == 0:
-                        job.worker_iteration_end(metrics)
-                        job.worker_iteration_start(config or {}, metrics)
+                        key, entries = item
+                        by_query = {}
+                        primary_empty = True
+                        for q in queries:
+                            sliced = apply_slice(entries, q)
+                            by_query[q] = sliced
+                            if q is primary and sliced:
+                                primary_empty = False
+                        if primary_empty:
+                            continue  # row lacks the primary query → skip
+                        try:
+                            job.process(key, by_query, metrics)
+                            metrics.increment(ScanMetrics.SUCCESS)
+                        except Exception:
+                            log.exception("scan job failed on row %r", key)
+                            metrics.increment(ScanMetrics.FAILURE)
+                        processed += 1
+                        if processed % block_size == 0:
+                            job.worker_iteration_end(metrics)
+                            job.worker_iteration_start(config or {}, metrics)
+                    except BaseException as e:  # slicing/iteration machinery
+                        errors.append(e)
+                        failed = True
             finally:
-                job.worker_iteration_end(metrics)
+                try:
+                    job.worker_iteration_end(metrics)
+                except BaseException as e:
+                    errors.append(e)
 
         pt = threading.Thread(target=puller, name="scan-puller", daemon=True)
         workers = [threading.Thread(target=processor, name=f"scan-proc-{i}",
