@@ -1,0 +1,309 @@
+"""Index subsystem suite: composite + mixed indexes, Geoshape, lifecycle.
+
+Modeled on the reference's TitanIndexTest / IndexSerializer coverage
+(titan-test): composite equality retrieval, uniqueness, multi-key indexes,
+mixed text/numeric/geo queries, tx-delta visibility, persistence across
+reopen.
+"""
+
+import pytest
+
+import titan_tpu
+from titan_tpu.core.attribute import Geoshape
+from titan_tpu.errors import SchemaViolationError
+from titan_tpu.query.predicates import P
+
+
+@pytest.fixture(params=["inmemory", "sqlite"])
+def g(request, tmp_path):
+    if request.param == "inmemory":
+        graph = titan_tpu.open({"storage.backend": "inmemory",
+                                "index.search.backend": "memindex"})
+    else:
+        graph = titan_tpu.open({"storage.backend": "sqlite",
+                                "storage.directory": str(tmp_path / "db"),
+                                "index.search.backend": "memindex",
+                                "index.search.directory": str(tmp_path / "idx")})
+    yield graph
+    graph.close()
+
+
+def _mk_people(g, n=5):
+    tx = g.new_transaction()
+    ids = []
+    for i in range(n):
+        v = tx.add_vertex("person", name=f"p{i}", age=20 + i)
+        ids.append(v.id)
+    tx.commit()
+    return ids
+
+
+# -- composite ----------------------------------------------------------------
+
+def test_composite_index_equality(g):
+    mgmt = g.management()
+    name = mgmt.make_property_key("name", str)
+    mgmt.build_index("byName", "vertex").add_key(name).build_composite_index()
+    mgmt.commit()
+    ids = _mk_people(g)
+
+    tx = g.new_transaction()
+    hits = tx.query().has("name", "p3").vertices()
+    assert [v.id for v in hits] == [ids[3]]
+    assert tx.query().has("name", "nope").vertices() == []
+    tx.commit()
+
+
+def test_composite_index_multi_key(g):
+    mgmt = g.management()
+    k1 = mgmt.make_property_key("first", str)
+    k2 = mgmt.make_property_key("last", str)
+    mgmt.build_index("byFullName", "vertex").add_key(k1).add_key(k2) \
+        .build_composite_index()
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    a = tx.add_vertex(first="ada", last="lovelace")
+    tx.add_vertex(first="ada", last="wong")
+    tx.commit()
+
+    tx = g.new_transaction()
+    hits = tx.query().has("first", "ada").has("last", "lovelace").vertices()
+    assert [v.id for v in hits] == [a.id]
+    # only one key bound -> index doesn't cover, full-scan fallback still works
+    assert len(tx.query().has("first", "ada").vertices()) == 2
+    tx.commit()
+
+
+def test_composite_index_updates_on_change(g):
+    mgmt = g.management()
+    name = mgmt.make_property_key("name", str)
+    mgmt.build_index("byName2", "vertex").add_key(name).build_composite_index()
+    mgmt.commit()
+    [vid] = _mk_people(g, 1)
+
+    tx = g.new_transaction()
+    tx.vertex(vid).property("name", "renamed")
+    tx.commit()
+
+    tx = g.new_transaction()
+    assert tx.query().has("name", "p0").vertices() == []
+    assert [v.id for v in tx.query().has("name", "renamed").vertices()] == [vid]
+    # removal drops the entry
+    tx.vertex(vid).remove()
+    tx.commit()
+    tx = g.new_transaction()
+    assert tx.query().has("name", "renamed").vertices() == []
+    tx.commit()
+
+
+def test_unique_index(g):
+    mgmt = g.management()
+    ssn = mgmt.make_property_key("ssn", str)
+    mgmt.build_index("bySsn", "vertex").add_key(ssn).unique() \
+        .build_composite_index()
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    tx.add_vertex(ssn="123")
+    tx.commit()
+
+    tx = g.new_transaction()
+    tx.add_vertex(ssn="123")
+    with pytest.raises(SchemaViolationError):
+        tx.commit()
+    # different value is fine
+    tx = g.new_transaction()
+    tx.add_vertex(ssn="456")
+    tx.commit()
+
+
+def test_index_sees_tx_delta(g):
+    mgmt = g.management()
+    name = mgmt.make_property_key("name", str)
+    mgmt.build_index("byName3", "vertex").add_key(name).build_composite_index()
+    mgmt.commit()
+    ids = _mk_people(g, 2)
+
+    tx = g.new_transaction()
+    v = tx.add_vertex(name="fresh")          # uncommitted
+    tx.vertex(ids[0]).remove()               # uncommitted removal
+    hits = {u.id for u in tx.query().has("name", "fresh").vertices()}
+    assert hits == {v.id}
+    assert tx.query().has("name", "p0").vertices() == []
+    tx.rollback()
+
+
+def test_edge_composite_index(g):
+    mgmt = g.management()
+    since = mgmt.make_property_key("since", int)
+    mgmt.build_index("bySince", "edge").add_key(since).build_composite_index()
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    a = tx.add_vertex(name="a")
+    b = tx.add_vertex(name="b")
+    e = tx.add_edge(a, "knows", b, {"since": 1999})
+    tx.add_edge(b, "knows", a, {"since": 2024})
+    tx.commit()
+
+    tx = g.new_transaction()
+    hits = tx.query().has("since", 1999).edges()
+    assert [h.id for h in hits] == [e.id]
+    assert hits[0].label() == "knows"
+    tx.commit()
+
+
+def test_index_survives_reopen(tmp_path):
+    cfg = {"storage.backend": "sqlite",
+           "storage.directory": str(tmp_path / "db")}
+    g = titan_tpu.open(cfg)
+    mgmt = g.management()
+    name = mgmt.make_property_key("name", str)
+    mgmt.build_index("byName", "vertex").add_key(name).build_composite_index()
+    mgmt.commit()
+    tx = g.new_transaction()
+    vid = tx.add_vertex(name="durable").id
+    tx.commit()
+    g.close()
+
+    g = titan_tpu.open(cfg)
+    tx = g.new_transaction()
+    assert [v.id for v in tx.query().has("name", "durable").vertices()] == [vid]
+    idx = g.management().get_graph_index("byName")
+    assert idx is not None and idx.composite
+    tx.commit()
+    g.close()
+
+
+def test_index_lifecycle_status(g):
+    """An index over a pre-existing key starts INSTALLED and is not used."""
+    from titan_tpu.core.defs import SchemaStatus
+    _mk_people(g, 1)   # auto-creates "name" before the index exists
+    mgmt = g.management()
+    idx = mgmt.build_index("late", "vertex").add_key("name") \
+        .build_composite_index()
+    assert idx.status is SchemaStatus.INSTALLED
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    # falls back to full scan (INSTALLED index is not queryable) and still
+    # finds the pre-existing vertex
+    assert len(tx.query().has("name", "p0").vertices()) == 1
+    tx.commit()
+
+
+# -- mixed --------------------------------------------------------------------
+
+def test_mixed_text_and_range(g):
+    mgmt = g.management()
+    desc = mgmt.make_property_key("desc", str)
+    age = mgmt.make_property_key("age2", int)
+    mgmt.build_index("search1", "vertex").add_key(desc, "TEXT") \
+        .add_key(age).build_mixed_index("search")
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    v1 = tx.add_vertex(desc="the quick brown fox", age2=10)
+    v2 = tx.add_vertex(desc="a lazy dog sleeps", age2=20)
+    v3 = tx.add_vertex(desc="quick silver dog", age2=30)
+    tx.commit()
+
+    tx = g.new_transaction()
+    hits = {v.id for v in tx.query().has("desc", P.text_contains("quick"))
+            .vertices()}
+    assert hits == {v1.id, v3.id}
+    hits = {v.id for v in tx.query().has("desc", P.text_contains("dog"))
+            .has("age2", P.gt(25)).vertices()}
+    assert hits == {v3.id}
+    hits = {v.id for v in tx.query().has("age2", P.between(10, 25)).vertices()}
+    assert hits == {v1.id, v2.id}
+    tx.commit()
+
+
+def test_mixed_updates_and_removal(g):
+    mgmt = g.management()
+    desc = mgmt.make_property_key("bio", str)
+    mgmt.build_index("search2", "vertex").add_key(desc, "TEXT") \
+        .build_mixed_index("search")
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    v = tx.add_vertex(bio="loves graphs")
+    tx.commit()
+
+    tx = g.new_transaction()
+    tx.vertex(v.id).property("bio", "loves tensors")
+    tx.commit()
+
+    tx = g.new_transaction()
+    assert tx.query().has("bio", P.text_contains("graphs")).vertices() == []
+    assert len(tx.query().has("bio", P.text_contains("tensors")).vertices()) == 1
+    tx.vertex(v.id).remove()
+    tx.commit()
+
+    tx = g.new_transaction()
+    assert tx.query().has("bio", P.text_contains("tensors")).vertices() == []
+    tx.commit()
+
+
+def test_mixed_geo(g):
+    mgmt = g.management()
+    place = mgmt.make_property_key("place", Geoshape)
+    mgmt.build_index("geo1", "vertex").add_key(place).build_mixed_index("search")
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    sf = tx.add_vertex(place=Geoshape.point(37.77, -122.42))
+    nyc = tx.add_vertex(place=Geoshape.point(40.71, -74.0))
+    tx.commit()
+
+    tx = g.new_transaction()
+    bay = Geoshape.circle(37.75, -122.4, 50)
+    hits = {v.id for v in tx.query().has("place", P.geo_within(bay)).vertices()}
+    assert hits == {sf.id}
+    box = Geoshape.box(35.0, -125.0, 45.0, -70.0)
+    hits = {v.id for v in tx.query().has("place", P.geo_within(box)).vertices()}
+    assert hits == {sf.id, nyc.id}
+    tx.commit()
+
+
+def test_raw_index_query(g):
+    mgmt = g.management()
+    desc = mgmt.make_property_key("text", str)
+    mgmt.build_index("search3", "vertex").add_key(desc, "TEXT") \
+        .build_mixed_index("search")
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    v = tx.add_vertex(text="hello world")
+    tx.add_vertex(text="goodbye world")
+    tx.commit()
+
+    hits = g.index_query("search3", "text:hello")
+    assert [(el.id, s) for el, s in hits] == [(v.id, 1.0)]
+    assert len(g.index_query("search3", "world")) == 2
+
+
+# -- geoshape unit ------------------------------------------------------------
+
+def test_geoshape_geometry():
+    p = Geoshape.point(37.77, -122.42)
+    c = Geoshape.circle(37.75, -122.4, 50)
+    b = Geoshape.box(37.0, -123.0, 38.0, -122.0)
+    assert p.within(c) and p.within(b)
+    assert not Geoshape.point(40.7, -74.0).within(c)
+    assert c.intersect(b)
+    assert c.disjoint(Geoshape.circle(40.7, -74.0, 10))
+    d = Geoshape.distance_km((37.77, -122.42), (40.71, -74.0))
+    assert 4100 < d < 4200   # SF-NYC great-circle ~4130km
+
+
+def test_geoshape_roundtrip(g):
+    tx = g.new_transaction()
+    shape = Geoshape.circle(1.5, 2.5, 10.0)
+    v = tx.add_vertex(spot=shape)
+    tx.commit()
+    tx = g.new_transaction()
+    assert tx.vertex(v.id).value("spot") == shape
+    tx.commit()

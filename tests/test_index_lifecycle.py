@@ -1,0 +1,279 @@
+"""Index lifecycle + regression coverage for the review findings.
+
+Modeled on the reference's IndexRepairJob/IndexRemoveJob behavior under
+SchemaAction (titan-core graphdb/olap/job/, ManagementSystem.updateIndex).
+"""
+
+import pytest
+
+import titan_tpu
+from titan_tpu.core.defs import SchemaAction, SchemaStatus
+from titan_tpu.errors import SchemaViolationError, TitanError
+from titan_tpu.query.predicates import P
+
+
+@pytest.fixture
+def g():
+    graph = titan_tpu.open({"storage.backend": "inmemory",
+                            "index.search.backend": "memindex"})
+    yield graph
+    graph.close()
+
+
+def _seed(g, n=4):
+    tx = g.new_transaction()
+    ids = [tx.add_vertex("person", name=f"p{i}", score=i).id
+           for i in range(n)]
+    tx.commit()
+    return ids
+
+
+def test_register_reindex_enable(g):
+    ids = _seed(g)           # data exists BEFORE the index
+    mgmt = g.management()
+    idx = mgmt.build_index("lateName", "vertex").add_key("name") \
+        .build_composite_index()
+    assert idx.status is SchemaStatus.INSTALLED
+
+    mgmt.update_index(idx, SchemaAction.REGISTER_INDEX)
+    assert mgmt.get_graph_index("lateName").status is SchemaStatus.REGISTERED
+    mgmt.update_index("lateName", SchemaAction.REINDEX)
+    assert mgmt.get_graph_index("lateName").status is SchemaStatus.ENABLED
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    assert [v.id for v in tx.query().has("name", "p2").vertices()] == [ids[2]]
+    tx.commit()
+
+
+def test_reindex_mixed(g):
+    _seed(g)
+    mgmt = g.management()
+    idx = mgmt.build_index("lateSearch", "vertex").add_key("name", "TEXT") \
+        .build_mixed_index("search")
+    assert idx.status is SchemaStatus.INSTALLED
+    mgmt.update_index(idx, SchemaAction.REGISTER_INDEX)
+    mgmt.update_index(idx, SchemaAction.REINDEX)
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    assert len(tx.query().has("name", P.text_contains("p1")).vertices()) == 1
+    tx.commit()
+
+
+def test_disable_and_remove(g):
+    mgmt = g.management()
+    name = mgmt.make_property_key("name", str)
+    idx = mgmt.build_index("n1", "vertex").add_key(name) \
+        .build_composite_index()
+    mgmt.commit()
+    _seed(g)
+
+    mgmt = g.management()
+    mgmt.update_index("n1", SchemaAction.DISABLE_INDEX)
+    tx = g.new_transaction()
+    # disabled index is not queried — full scan still answers
+    assert len(tx.query().has("name", "p1").vertices()) == 1
+    tx.commit()
+
+    mgmt.update_index("n1", SchemaAction.REMOVE_INDEX)
+    # rows are gone from the graphindex store
+    from titan_tpu.codec.dataio import DataOutput
+    out = DataOutput()
+    out.put_uvar(idx.id)
+    prefix = out.getvalue()
+    store = g.backend.index_store.store
+    txh = g.backend.manager.begin_transaction()
+    rows = [k for k, es in store.get_keys(
+        __import__("titan_tpu.storage.api", fromlist=["SliceQuery"]).SliceQuery(),
+        txh) if k.startswith(prefix) and es]
+    txh.commit()
+    assert rows == []
+
+
+def test_illegal_transition(g):
+    mgmt = g.management()
+    name = mgmt.make_property_key("name", str)
+    mgmt.build_index("n2", "vertex").add_key(name).build_composite_index()
+    mgmt.commit()
+    with pytest.raises(TitanError):
+        mgmt.update_index("n2", SchemaAction.REGISTER_INDEX)  # already ENABLED
+    with pytest.raises(TitanError):
+        mgmt.update_index("n2", SchemaAction.REMOVE_INDEX)    # not DISABLED
+
+
+def test_installed_index_receives_no_writes(g):
+    _seed(g, 1)
+    mgmt = g.management()
+    idx = mgmt.build_index("cold", "vertex").add_key("name") \
+        .build_composite_index()
+    mgmt.commit()
+    tx = g.new_transaction()
+    tx.add_vertex(name="newbie")
+    tx.commit()
+    # INSTALLED: no writes landed in the index store
+    from titan_tpu.codec.dataio import DataOutput
+    out = DataOutput()
+    out.put_uvar(idx.id)
+    prefix = out.getvalue()
+    from titan_tpu.storage.api import SliceQuery
+    txh = g.backend.manager.begin_transaction()
+    rows = [k for k, es in g.backend.index_store.store.get_keys(
+        SliceQuery(), txh) if k.startswith(prefix) and es]
+    txh.commit()
+    assert rows == []
+
+
+# -- review-finding regressions ----------------------------------------------
+
+def test_query_sees_modified_vertex_in_tx(g):
+    """Index-backed query must surface a pre-existing vertex whose indexed
+    value changed inside the open transaction."""
+    mgmt = g.management()
+    name = mgmt.make_property_key("name", str)
+    mgmt.build_index("n3", "vertex").add_key(name).build_composite_index()
+    mgmt.commit()
+    ids = _seed(g, 2)
+
+    tx = g.new_transaction()
+    tx.vertex(ids[0]).property("name", "renamed")
+    hits = {v.id for v in tx.query().has("name", "renamed").vertices()}
+    assert hits == {ids[0]}
+    assert tx.query().has("name", "p0").vertices() == []
+    tx.rollback()
+
+
+def test_intra_tx_unique_violation(g):
+    mgmt = g.management()
+    ssn = mgmt.make_property_key("ssn", str)
+    mgmt.build_index("u1", "vertex").add_key(ssn).unique() \
+        .build_composite_index()
+    mgmt.commit()
+    tx = g.new_transaction()
+    tx.add_vertex(ssn="dup")
+    tx.add_vertex(ssn="dup")
+    with pytest.raises(SchemaViolationError):
+        tx.commit()
+
+
+def test_unique_value_moves_between_elements(g):
+    """Deleting the old holder and adding a new one in ONE tx must pass."""
+    mgmt = g.management()
+    ssn = mgmt.make_property_key("ssn", str)
+    mgmt.build_index("u2", "vertex").add_key(ssn).unique() \
+        .build_composite_index()
+    mgmt.commit()
+    tx = g.new_transaction()
+    a = tx.add_vertex(ssn="m1")
+    tx.commit()
+
+    tx = g.new_transaction()
+    tx.vertex(a.id).remove()
+    b = tx.add_vertex(ssn="m1")
+    tx.commit()   # must NOT raise
+
+    tx = g.new_transaction()
+    assert [v.id for v in tx.query().has("ssn", "m1").vertices()] == [b.id]
+    tx.commit()
+
+
+def test_has_not_on_edges(g):
+    tx = g.new_transaction()
+    a, b = tx.add_vertex(), tx.add_vertex()
+    e1 = tx.add_edge(a, "knows", b, {"w": 1})
+    e2 = tx.add_edge(b, "knows", a)
+    tx.commit()
+    tx = g.new_transaction()
+    hits = tx.query().has_not("w").edges()
+    assert [h.id for h in hits] == [e2.id]
+    # neq must not match edges lacking the key entirely
+    hits = tx.query().has("w", P.neq(5)).edges()
+    assert [h.id for h in hits] == [e1.id]
+    tx.commit()
+
+
+def test_geo_predicate_on_missing_field(g):
+    """Docs without the geo field must not crash the mixed query."""
+    from titan_tpu.core.attribute import Geoshape
+    mgmt = g.management()
+    place = mgmt.make_property_key("place", Geoshape)
+    desc = mgmt.make_property_key("desc", str)
+    mgmt.build_index("geo2", "vertex").add_key(place).add_key(desc, "TEXT") \
+        .build_mixed_index("search")
+    mgmt.commit()
+    tx = g.new_transaction()
+    tx.add_vertex(desc="no location here")
+    v = tx.add_vertex(place=Geoshape.point(10.0, 10.0), desc="located")
+    tx.commit()
+    tx = g.new_transaction()
+    hits = tx.query().has(
+        "place", P.geo_within(Geoshape.circle(10.0, 10.0, 5))).vertices()
+    assert [h.id for h in hits] == [v.id]
+    tx.commit()
+
+
+def test_edge_composite_and_mixed_intersection(g):
+    """Composite-edge 4-tuple hits and mixed-edge hits must intersect."""
+    mgmt = g.management()
+    since = mgmt.make_property_key("since", int)
+    weight = mgmt.make_property_key("weight", float)
+    mgmt.build_index("eSince", "edge").add_key(since).build_composite_index()
+    mgmt.build_index("eWeight", "edge").add_key(weight) \
+        .build_mixed_index("search")
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    a, b = tx.add_vertex(), tx.add_vertex()
+    e1 = tx.add_edge(a, "knows", b, {"since": 1999, "weight": 0.9})
+    tx.add_edge(b, "knows", a, {"since": 1999, "weight": 0.1})
+    tx.commit()
+
+    tx = g.new_transaction()
+    hits = tx.query().has("since", 1999).has("weight", P.gt(0.5)).edges()
+    assert [h.id for h in hits] == [e1.id]
+    tx.commit()
+
+
+def test_raw_query_on_edge_mixed_index(g):
+    mgmt = g.management()
+    note = mgmt.make_property_key("note", str)
+    mgmt.build_index("eNotes", "edge").add_key(note, "TEXT") \
+        .build_mixed_index("search")
+    mgmt.commit()
+    tx = g.new_transaction()
+    a, b = tx.add_vertex(), tx.add_vertex()
+    e = tx.add_edge(a, "rel", b, {"note": "important meeting"})
+    tx.commit()
+    hits = g.index_query("eNotes", "note:important")
+    assert [(el.id, s) for el, s in hits] == [(e.id, 1.0)]
+    with pytest.raises(TitanError):
+        g.index_query("note", "x")   # not an index
+
+
+def test_memindex_keyinfo_survives_reopen(tmp_path):
+    cfg = {"storage.backend": "sqlite",
+           "storage.directory": str(tmp_path / "db"),
+           "index.search.backend": "memindex",
+           "index.search.directory": str(tmp_path / "idx")}
+    g = titan_tpu.open(cfg)
+    mgmt = g.management()
+    code = mgmt.make_property_key("code", str)
+    mgmt.build_index("codes", "vertex").add_key(code, "STRING") \
+        .build_mixed_index("search")
+    mgmt.commit()
+    tx = g.new_transaction()
+    v = tx.add_vertex(code="alpha beta")
+    tx.commit()
+    g.close()
+
+    g = titan_tpu.open(cfg)
+    tx = g.new_transaction()
+    # STRING mapping must persist across reopen: exact-match queries still
+    # route through the index, and the provider still knows the mapping
+    assert [x.id for x in
+            tx.query().has("code", "alpha beta").vertices()] == [v.id]
+    provider = g.index_provider("search")
+    info = provider._stores["codes"].keyinfo["code"]
+    assert "STRING" in info.parameters
+    tx.commit()
+    g.close()

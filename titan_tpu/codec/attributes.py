@@ -186,6 +186,19 @@ def _r_date_ordered(buf: ReadBuffer) -> _dt.datetime:
                                       _dt.timezone.utc)
 
 
+def _w_geoshape(out: DataOutput, v):
+    flat = v.to_floats()
+    out.put_u8(len(flat))
+    for f in flat:
+        out.put_f64(f)
+
+
+def _r_geoshape(buf: ReadBuffer):
+    from titan_tpu.core.attribute import Geoshape
+    n = buf.get_u8()
+    return Geoshape.from_floats([buf.get_f64() for _ in range(n)])
+
+
 class Serializer:
     """Type registry + self-describing value codec."""
 
@@ -210,6 +223,8 @@ class Serializer:
         self.register(AttributeHandler(9, dict, self._w_dict, self._r_dict))
         self.register(AttributeHandler(10, type(None),
                                        lambda o, v: None, lambda b: None))
+        from titan_tpu.core.attribute import Geoshape
+        self.register(AttributeHandler(11, Geoshape, _w_geoshape, _r_geoshape))
 
     def register(self, h: AttributeHandler):
         if h.code in self._by_code or h.py_type in self._by_type:

@@ -76,3 +76,26 @@ class SchemaStatus(enum.Enum):
     REGISTERED = "registered"
     ENABLED = "enabled"
     DISABLED = "disabled"
+
+
+class SchemaAction(enum.Enum):
+    """Index lifecycle transitions (reference: core/schema/SchemaAction.java:12-50
+    — REGISTER_INDEX/REINDEX/ENABLE_INDEX/DISABLE_INDEX/REMOVE_INDEX with
+    applicable source states)."""
+    REGISTER_INDEX = "register"
+    REINDEX = "reindex"
+    ENABLE_INDEX = "enable"
+    DISABLE_INDEX = "disable"
+    REMOVE_INDEX = "remove"
+
+    def applicable_from(self, status: "SchemaStatus") -> bool:
+        return status in {
+            SchemaAction.REGISTER_INDEX: (SchemaStatus.INSTALLED,),
+            SchemaAction.REINDEX: (SchemaStatus.REGISTERED,
+                                   SchemaStatus.ENABLED),
+            SchemaAction.ENABLE_INDEX: (SchemaStatus.REGISTERED,),
+            SchemaAction.DISABLE_INDEX: (SchemaStatus.REGISTERED,
+                                         SchemaStatus.INSTALLED,
+                                         SchemaStatus.ENABLED),
+            SchemaAction.REMOVE_INDEX: (SchemaStatus.DISABLED,),
+        }[self]

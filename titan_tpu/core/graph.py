@@ -74,12 +74,47 @@ class StandardGraph:
             block_size=config.get(d.IDS_BLOCK_SIZE),
             renew_percentage=config.get(d.IDS_RENEW_PERCENTAGE))
         self.schema = SchemaManager(self)
+        from titan_tpu.indexing.serializer import IndexSerializer
+        self.index_serializer = IndexSerializer(self.serializer, self.idm,
+                                                self.schema)
         self.auto_schema = True
         self.allow_custom_vid = config.get(d.ALLOW_SETTING_VERTEX_ID)
         self._open = True
         self._tlocal = threading.local()
-        self._index_providers: dict = {}   # name -> IndexProvider (index milestone)
+        self._index_providers: dict = {}   # name -> IndexProvider
+        for name in config.container_names(d.INDEX_NS):
+            self._open_index_provider(name)
         self._commit_lock = threading.Lock()
+
+    # -- mixed index providers ----------------------------------------------
+
+    def _open_index_provider(self, name: str):
+        from titan_tpu.config import defaults as d
+        backend = self.config.get(d.INDEX_BACKEND, name)
+        directory = self.config.get(d.INDEX_DIRECTORY, name)
+        if backend in ("memindex", "lucene", "elasticsearch", "solr"):
+            # every local shorthand maps to the in-process provider; real
+            # cluster providers plug in via import path
+            from titan_tpu.indexing.memindex import MemoryIndex
+            provider = MemoryIndex(name, directory or None)
+        else:
+            import importlib
+            mod, _, cls = backend.rpartition(".")
+            provider = getattr(importlib.import_module(mod), cls)(
+                name, directory or None)
+        self._index_providers[name] = provider
+        return provider
+
+    def index_provider(self, name: str):
+        """Provider by config name; opens on demand so an index built before
+        the provider was configured still resolves."""
+        p = self._index_providers.get(name)
+        if p is None and name:
+            try:
+                p = self._open_index_provider(name)
+            except Exception:
+                return None
+        return p
 
     # -- transactions --------------------------------------------------------
 
@@ -114,6 +149,40 @@ class StandardGraph:
 
     def vertices(self):
         return self.tx().vertices()
+
+    def query(self):
+        """Graph-centric query (reference: TitanGraph.query())."""
+        return self.tx().query()
+
+    def index_query(self, index_name: str, raw: str, limit=None, offset=0):
+        """Direct native query against a mixed index (reference:
+        TitanGraph.indexQuery → IndexQueryBuilder). Yields (element, score)."""
+        from titan_tpu.core.schema import IndexDefinition
+        from titan_tpu.indexing.provider import RawQuery
+        st = self.schema.get_by_name(index_name)
+        if not isinstance(st, IndexDefinition) or st.composite:
+            raise TitanError(f"{index_name!r} is not a mixed index")
+        provider = self.index_provider(st.backing)
+        if provider is None:
+            raise TitanError(f"provider {st.backing!r} not configured")
+        tx = self.tx()
+        out = []
+        hits = provider.raw_query(index_name,
+                                  RawQuery(raw, limit=limit, offset=offset))
+        if st.element == "vertex":
+            for docid, score in hits:
+                el = tx.vertex(self.index_serializer.element_id_of(docid))
+                if el is not None:
+                    out.append((el, score))
+            return out
+        from titan_tpu.query.graphquery import GraphQuery
+        eids = [self.index_serializer.element_id_of(d) for d, _ in hits]
+        rel_map = GraphQuery(tx)._edges_by_rel_ids(set(eids))
+        for (docid, score), eid in zip(hits, eids):
+            el = rel_map.get(eid)
+            if el is not None:
+                out.append((el, score))
+        return out
 
     def commit(self):
         cur = getattr(self._tlocal, "tx", None)
@@ -169,7 +238,42 @@ class StandardGraph:
                     lock_targets.setdefault(
                         (self.idm.key_bytes(vid), entry.column), None)
 
+        # index updates implied by this tx (reference: prepareCommit collects
+        # IndexUpdates per mutation, IndexSerializer.getIndexUpdates)
+        index_updates = self.index_serializer.collect_updates(tx)
+        idx_additions: dict[bytes, list] = {}
+        idx_deletions: dict[bytes, list] = {}
+        unique_adds: list = []            # (row_key, column) to enforce
+        mixed_updates: list = []
+        for u in index_updates:
+            if u.key is None:
+                mixed_updates.append(u)
+                continue
+            if u.addition:
+                idx_additions.setdefault(u.key, []).append(u.entry)
+                if u.index.unique:
+                    unique_adds.append((u.key, u.entry.column))
+            else:
+                idx_deletions.setdefault(u.key, []).append(u.entry.column)
+
         btx = tx.backend_tx
+        for u in mixed_updates:   # buffered; flushed by commit_indexes
+            itx = btx.index_txs.get(u.index.backing)
+            if itx is None:
+                # the backend tx may have snapshotted index_txs before this
+                # provider was (lazily) opened — attach a fresh provider tx
+                provider = self.index_provider(u.index.backing)
+                if provider is None:
+                    raise TitanError(
+                        f"mixed index {u.index.name!r} needs provider "
+                        f"{u.index.backing!r} — configure "
+                        f"index.{u.index.backing}.backend")
+                itx = btx.index_txs.setdefault(u.index.backing,
+                                               provider.begin_transaction())
+            if u.addition:
+                itx.add(u.index.name, u.docid, u.field, u.value)
+            else:
+                itx.delete(u.index.name, u.docid, u.field)
         locker = self.backend.locker
         lock_state = tx._lock_state
         try:
@@ -179,23 +283,41 @@ class StandardGraph:
                     lid = LockID("edgestore", key, column)
                     lock_state.expected.setdefault(lid, expected)
                     locker.write_lock(lid, lock_state)
+            if unique_adds and locker is not None:
+                from titan_tpu.storage.locking import LockID
+                for row_key, _col in unique_adds:
+                    lid = LockID("graphindex", row_key, b"\x00u")
+                    lock_state.expected.setdefault(lid, None)
+                    locker.write_lock(lid, lock_state)
 
             wal, txid = self._wal, None
             if wal is not None:
                 txid = wal.next_txid()
-                wal.log_precommit(txid, {
+                payload = {
                     "edgestore": {key: ([tuple(e) for e in additions.get(key, [])],
                                         list(deletions.get(key, [])))
-                                  for key in set(additions) | set(deletions)}})
+                                  for key in set(additions) | set(deletions)}}
+                if idx_additions or idx_deletions:
+                    payload["graphindex"] = {
+                        key: ([tuple(e) for e in idx_additions.get(key, [])],
+                              list(idx_deletions.get(key, [])))
+                        for key in set(idx_additions) | set(idx_deletions)}
+                wal.log_precommit(txid, payload)
 
             with self._commit_lock:
                 if lock_state.has_locks and locker is not None:
                     locker.check_locks(lock_state, self._read_current_value)
+                self._check_unique(unique_adds, idx_deletions)
                 for key in set(additions) | set(deletions):
                     btx.mutate_edges(
                         key,
                         additions.get(key, ()),
                         deletions.get(key, ()))
+                for key in set(idx_additions) | set(idx_deletions):
+                    btx.mutate_index(
+                        key,
+                        idx_additions.get(key, ()),
+                        idx_deletions.get(key, ()))
                 try:
                     btx.commit_storage()
                 except BaseException:
@@ -228,9 +350,11 @@ class StandardGraph:
     def _read_current_value(self, lid) -> Optional[bytes]:
         from titan_tpu.storage.api import KeySliceQuery, SliceQuery
         from titan_tpu.codec.relation_ids import next_prefix
+        store = (self.backend.index_store.store if lid.store == "graphindex"
+                 else self.backend.edge_store.store)
         txh = self.backend.manager.begin_transaction()
         try:
-            entries = self.backend.edge_store.store.get_slice(
+            entries = store.get_slice(
                 KeySliceQuery(lid.key, SliceQuery(lid.column,
                                                   next_prefix(lid.column))), txh)
         finally:
@@ -239,6 +363,37 @@ class StandardGraph:
             if e.column == lid.column:
                 return e.value
         return None
+
+    def _check_unique(self, unique_adds: list, idx_deletions: dict) -> None:
+        """Uniqueness constraint: the composite row of a unique index must be
+        empty (or already hold only this element) before the write — entries
+        this same transaction deletes don't count, so a unique value can move
+        between elements in one commit. (reference: unique composite indexes
+        lock the index row and fail on a conflicting entry)"""
+        if not unique_adds:
+            return
+        from titan_tpu.storage.api import KeySliceQuery, SliceQuery
+        from titan_tpu.errors import SchemaViolationError
+        by_row: dict[bytes, set] = {}
+        for row_key, column in unique_adds:   # intra-tx duplicates
+            by_row.setdefault(row_key, set()).add(column)
+            if len(by_row[row_key]) > 1:
+                raise SchemaViolationError(
+                    "unique index constraint violated: two elements in this "
+                    "transaction share the same indexed value")
+        txh = self.backend.manager.begin_transaction()
+        try:
+            for row_key, column in unique_adds:
+                dropped = set(idx_deletions.get(row_key, ()))
+                entries = self.backend.index_store.store.get_slice(
+                    KeySliceQuery(row_key, SliceQuery()), txh)
+                for e in entries:
+                    if e.column != column and e.column not in dropped:
+                        raise SchemaViolationError(
+                            "unique index constraint violated: value already "
+                            "bound to another element")
+        finally:
+            txh.commit()
 
     def _serialize(self, rel):
         """Yield (vertex_id, Entry) per materialized endpoint row."""
@@ -282,11 +437,18 @@ class StandardGraph:
         except Exception:
             pass
         self.id_assigner.close()
+        for provider in self._index_providers.values():
+            try:
+                provider.close()
+            except Exception:
+                pass
         self.backend.close()
 
     def clear(self) -> None:
         """Drop all data (test helper; reference: TitanCleanup)."""
         self.backend.clear_storage()
+        for provider in self._index_providers.values():
+            provider.clear_storage()
         self.schema.expire()
 
     def __enter__(self):

@@ -28,6 +28,7 @@ from titan_tpu.storage.api import Entry, KeySliceQuery, SliceQuery
 
 _NAME_INDEX_PREFIX = b"\x00sn\x00"   # system rows in graphindex
 _NAME_COLUMN = b"\x00"
+_INDEX_REGISTRY_KEY = b"\x00sidx\x00"   # column per index name -> schema id
 
 # dtype registry: stored code <-> python type (extend via register_dtype)
 _DTYPES: dict[str, type] = {}
@@ -42,9 +43,11 @@ def register_dtype(name: str, t: type) -> None:
 import datetime as _dt
 import uuid as _uuid
 
+from titan_tpu.core.attribute import Geoshape as _Geoshape
+
 for _n, _t in [("bool", bool), ("int", int), ("float", float), ("str", str),
                ("bytes", bytes), ("uuid", _uuid.UUID), ("datetime", _dt.datetime),
-               ("list", list), ("dict", dict)]:
+               ("list", list), ("dict", dict), ("geoshape", _Geoshape)]:
     register_dtype(_n, _t)
 
 
@@ -104,6 +107,42 @@ class VertexLabel(SchemaType):
                 "static": self.static}
 
 
+@dataclass(frozen=True)
+class IndexDefinition(SchemaType):
+    """A graph index — composite (graphindex store) or mixed (external
+    provider). (reference: graphdb/types/indextype/*, TitanGraphIndex in
+    core/schema/ — indexes are schema vertices like everything else.)
+
+    ``key_ids`` is ordered (composite row-key field order). ``key_params``
+    aligns with it (mixed-index mapping hints, e.g. ``"TEXT"``/``"STRING"``).
+    ``status`` drives the lifecycle: writes go to REGISTERED+ENABLED indexes,
+    reads only use ENABLED ones (reference: SchemaStatus semantics).
+    """
+    element: str = "vertex"                     # vertex | edge
+    composite: bool = True
+    key_ids: tuple = ()
+    key_params: tuple = ()
+    unique: bool = False
+    backing: str = ""                           # mixed: provider name
+    index_only: int = 0                         # restrict to label/type id
+    status: SchemaStatus = SchemaStatus.ENABLED
+
+    def definition(self) -> dict:
+        return {"kind": "index", "element": self.element,
+                "composite": self.composite, "key_ids": list(self.key_ids),
+                "key_params": list(self.key_params), "unique": self.unique,
+                "backing": self.backing, "index_only": self.index_only,
+                "status": self.status.value}
+
+    @property
+    def writable(self) -> bool:
+        return self.status in (SchemaStatus.REGISTERED, SchemaStatus.ENABLED)
+
+    @property
+    def queryable(self) -> bool:
+        return self.status is SchemaStatus.ENABLED
+
+
 def _from_definition(schema_id: int, name: str, d: dict) -> SchemaType:
     kind = d["kind"]
     if kind == "key":
@@ -120,6 +159,12 @@ def _from_definition(schema_id: int, name: str, d: dict) -> SchemaType:
     if kind == "vertexlabel":
         return VertexLabel(schema_id, name, d.get("partitioned", False),
                            d.get("static", False))
+    if kind == "index":
+        return IndexDefinition(schema_id, name, d["element"], d["composite"],
+                               tuple(d["key_ids"]), tuple(d["key_params"]),
+                               d["unique"], d.get("backing", ""),
+                               d.get("index_only", 0),
+                               SchemaStatus(d.get("status", "enabled")))
     raise SchemaViolationError(f"unknown schema kind {kind!r}")
 
 
@@ -135,6 +180,7 @@ class SchemaManager:
         self.system = SystemTypes(self.idm)
         self._by_id: dict[int, SchemaType] = {}
         self._by_name: dict[str, int] = {}
+        self._index_ids: Optional[list] = None   # cached registry row
         self._lock = threading.RLock()
 
     # -- TypeInspector protocol (codec callbacks) ----------------------------
@@ -271,6 +317,76 @@ class SchemaManager:
         """Rewrite a type's definition (index lifecycle transitions etc.)."""
         return self._store_type(st, expect_new=False)
 
+    # -- graph indexes -------------------------------------------------------
+
+    def make_index(self, name: str, element: str, composite: bool,
+                   key_ids: tuple, key_params: tuple = (),
+                   unique: bool = False, backing: str = "",
+                   index_only: int = 0,
+                   status: SchemaStatus = SchemaStatus.ENABLED
+                   ) -> IndexDefinition:
+        for kid in key_ids:
+            if not isinstance(self.get_type(kid), PropertyKey):
+                raise SchemaViolationError("index keys must be property keys")
+        if composite:
+            for kid in key_ids:
+                if not self.serializer.orderable(self.data_type(kid)):
+                    raise SchemaViolationError(
+                        "composite index keys need byte-ordered dtypes")
+        if unique and (not composite or element != "vertex"):
+            raise SchemaViolationError(
+                "uniqueness requires a composite vertex index")
+        if not key_params:
+            key_params = ("DEFAULT",) * len(key_ids)
+        sid = self._graph.id_assigner.next_schema_id(IDType.GENERIC_SCHEMA)
+        idx = self._store_type(IndexDefinition(
+            sid, name, element, composite, tuple(key_ids), tuple(key_params),
+            unique, backing, index_only, status))
+        self._register_index(idx)
+        return idx
+
+    def _register_index(self, idx: IndexDefinition) -> None:
+        backend = self._graph.backend
+        txh = backend.manager.begin_transaction()
+        try:
+            backend.index_store.store.mutate(
+                _INDEX_REGISTRY_KEY,
+                [Entry(idx.name.encode("utf-8"), idx.id.to_bytes(8, "big"))],
+                [], txh)
+            txh.commit()
+        except BaseException:
+            txh.rollback()
+            raise
+        with self._lock:
+            self._index_ids = None
+        backend.index_store.invalidate(_INDEX_REGISTRY_KEY)
+
+    def indexes(self, element: Optional[str] = None) -> list:
+        """All graph indexes (optionally only vertex/edge ones)."""
+        with self._lock:
+            ids = self._index_ids
+        if ids is None:
+            backend = self._graph.backend
+            txh = backend.manager.begin_transaction()
+            try:
+                entries = backend.index_store.store.get_slice(
+                    KeySliceQuery(_INDEX_REGISTRY_KEY, SliceQuery()), txh)
+            finally:
+                txh.commit()
+            ids = [int.from_bytes(e.value, "big") for e in entries]
+            with self._lock:
+                self._index_ids = ids
+        out = []
+        for iid in ids:
+            idx = self.get_type(iid)
+            if isinstance(idx, IndexDefinition) and \
+                    (element is None or idx.element == element):
+                out.append(idx)
+        return out
+
+    def indexes_for_key(self, key_id: int, element: str) -> list:
+        return [ix for ix in self.indexes(element) if key_id in ix.key_ids]
+
     # -- storage -------------------------------------------------------------
 
     def _name_index_key(self, name: str) -> bytes:
@@ -340,6 +456,7 @@ class SchemaManager:
 
     def expire(self, schema_id: Optional[int] = None) -> None:
         with self._lock:
+            self._index_ids = None
             if schema_id is None:
                 self._by_id.clear()
                 self._by_name.clear()

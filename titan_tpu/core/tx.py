@@ -437,6 +437,14 @@ class GraphTransaction:
                     out[vid].append(Edge(self, rel))
         return out
 
+    # ------------------------------------------------------ graph-centric query
+
+    def query(self):
+        """``tx.query().has(...)`` (reference: TitanTransaction.query())."""
+        from titan_tpu.query.graphquery import GraphQuery
+        self._check_open()
+        return GraphQuery(self)
+
     # ------------------------------------------------------------- lifecycle
 
     def commit(self) -> None:

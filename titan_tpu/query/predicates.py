@@ -107,3 +107,21 @@ class P:
         rx = re.compile(pattern)
         return P("stringRegex", pattern,
                  lambda c: rx.fullmatch(str(c)) is not None)
+
+    # -- geo (reference: core/attribute/Geo.java) ----------------------------
+
+    @staticmethod
+    def geo_within(shape):
+        return P("geoWithin", shape, lambda c: c.within(shape))
+
+    @staticmethod
+    def geo_intersect(shape):
+        return P("geoIntersect", shape, lambda c: c.intersect(shape))
+
+    @staticmethod
+    def geo_disjoint(shape):
+        return P("geoDisjoint", shape, lambda c: c.disjoint(shape))
+
+    @staticmethod
+    def geo_contains(shape):
+        return P("geoContains", shape, lambda c: shape.within(c))

@@ -155,8 +155,15 @@ class KCVSLog:
         start = marker.start_time
         if marker.identifier is not None:
             saved = self._load_marker(marker.identifier)
-            if saved is not None:
-                start = saved
+            if saved:
+                # per-bucket cursors: a lagging bucket must resume from ITS
+                # read position, not the max across buckets, or its unread
+                # messages would be skipped (at-least-once guarantee)
+                fallback = marker.start_time
+                if fallback is None:
+                    fallback = min(saved.values())
+                start = {b: saved.get(b, fallback)
+                         for b in range(self._num_buckets)}
         if start is None:
             start = self._times.time()
         stop = threading.Event()
@@ -166,7 +173,8 @@ class KCVSLog:
         self._readers.append((callback, marker, thread, stop))
         thread.start()
 
-    def _load_marker(self, ident: str) -> Optional[int]:
+    def _load_marker(self, ident: str) -> Optional[dict]:
+        """→ {bucket: last-read ts} or None when no marker was persisted."""
         txh = self._manager.begin_transaction()
         try:
             entries = self._store.get_slice(
@@ -175,7 +183,7 @@ class KCVSLog:
             txh.commit()
         if not entries:
             return None
-        return max(int.from_bytes(e.value, "big") for e in entries)
+        return {e.column[0]: int.from_bytes(e.value, "big") for e in entries}
 
     def _save_marker(self, ident: str, bucket: int, ts: int) -> None:
         txh = self._manager.begin_transaction()
@@ -187,9 +195,12 @@ class KCVSLog:
         except BaseException:
             txh.rollback()
 
-    def _read_loop(self, marker: ReadMarker, callback, start: int,
+    def _read_loop(self, marker: ReadMarker, callback, start,
                    stop: threading.Event) -> None:
-        cursors = {b: start for b in range(self._num_buckets)}
+        if isinstance(start, dict):
+            cursors = dict(start)
+        else:
+            cursors = {b: start for b in range(self._num_buckets)}
         while not stop.is_set() and not self._closed:
             for bucket in range(self._num_buckets):
                 try:
