@@ -52,9 +52,18 @@ class Traverser:
 class GraphTraversalSource:
     """``g = graph.traversal()``"""
 
-    def __init__(self, graph, tx=None):
+    def __init__(self, graph, tx=None, computer=None, snapshot=None):
         self.graph = graph
         self._tx = tx
+        self._computer = computer          # None = OLTP interpreter; "tpu"
+        self._snapshot = snapshot          # reusable CSR snapshot
+
+    def with_computer(self, computer: str = "tpu", snapshot=None
+                      ) -> "GraphTraversalSource":
+        """Route compilable read traversals through the TPU OLAP engine
+        (reference: TitanBlueprintsGraph.compute() engine selection —
+        unsupported patterns fall back to the OLTP interpreter)."""
+        return GraphTraversalSource(self.graph, self._tx, computer, snapshot)
 
     @property
     def tx(self):
@@ -76,8 +85,43 @@ class GraphTraversalSource:
         return t
 
 
+def anon() -> "Traversal":
+    """Anonymous sub-traversal for repeat() bodies — the TinkerPop ``__``
+    (double-underscore) helper."""
+    return Traversal(None)
+
+
+def conditions_to_query(q, conditions):
+    """Translate folded has-conditions onto a GraphQuery. Returns the id
+    filter set (or None), or raises _Unsupported when a condition can't be
+    answered by the graph-centric engine (pseudo-keys, multi-label OR)."""
+    id_filter = None
+    for name, args in conditions:
+        if name in ("has", "hasKey") and args[0] in ("id", "label"):
+            raise _Unsupported(args[0])   # pseudo-keys: stream filter instead
+        if name == "has":
+            q.has(args[0], args[1])
+        elif name == "hasKey":
+            q.has(args[0])
+        elif name == "hasLabel":
+            labels = args[0]
+            if len(labels) != 1:
+                raise _Unsupported("multi-label")
+            q.has_label(labels[0])
+        elif name == "hasId":
+            ids = set(args[0])
+            id_filter = ids if id_filter is None else id_filter & ids
+        else:
+            raise _Unsupported(name)
+    return id_filter
+
+
+class _Unsupported(Exception):
+    pass
+
+
 class Traversal:
-    def __init__(self, source: GraphTraversalSource):
+    def __init__(self, source: Optional[GraphTraversalSource]):
         self.source = source
         self._steps: list[tuple] = []
         self._path_needed = False
@@ -232,10 +276,29 @@ class Traversal:
         raise StopIteration
 
     def _execute(self) -> Iterator[Traverser]:
+        if self.source is None:
+            raise ValueError(
+                "anonymous traversal can only be used as a sub-traversal")
         tx = self.source.tx
         steps = self._fold_has_into_start(list(self._steps))
+
+        # OLAP compilation: a supported V().has(...).out()...count() chain on
+        # the tpu computer runs as CSR supersteps instead of interpretation
+        if self.source._computer == "tpu":
+            from titan_tpu.traversal.olap_compile import try_compile
+            compiled = try_compile(steps, self.source)
+            if compiled is not None:
+                return compiled.run()
+
         traversers: Iterable[Traverser] = iter(())
         i = 0
+        # V().has(...) start goes through the index-aware query engine
+        if len(steps) >= 2 and steps[0] == ("V", ()) and \
+                steps[1][0] == "Vfiltered":
+            indexed = self._indexed_start(tx, steps[1][1][0])
+            if indexed is not None:
+                traversers = indexed
+                i = 2
         while i < len(steps):
             name, args = steps[i]
             # repeat(...).times(n) pairs up
@@ -444,12 +507,28 @@ class Traversal:
         raise ValueError(f"unknown step {name!r}")
 
     def _apply_conditions(self, tx, traversers, conditions):
-        """Apply folded has-conditions; graph-centric index selection plugs in
-        here (query/graphquery.py) once indexes exist."""
+        """Apply folded has-conditions by streaming filters (used when the
+        start step isn't a bare V() — e.g. V(ids).has(...))."""
         stream = traversers
         for name, args in conditions:
             stream = self._apply(tx, stream, name, args)
         return stream
+
+    def _indexed_start(self, tx, conditions):
+        """Answer V().has(...) through the graph-centric query engine so a
+        composite/mixed index serves the start step (reference:
+        TitanGraphStepStrategy folding has() into TitanGraphStep, which
+        GraphCentricQueryBuilder then answers from an index). None when a
+        condition needs the streaming filters (pseudo-keys, multi-label)."""
+        q = tx.query()
+        try:
+            id_filter = conditions_to_query(q, conditions)
+        except _Unsupported:
+            return None
+        vertices = q.vertices()
+        if id_filter is not None:
+            vertices = [v for v in vertices if v.id in id_filter]
+        return (Traverser(v) for v in vertices)
 
     # batched adjacency: ONE multiQuery per frontier batch
     def _vertex_step(self, tx, traversers, direction, labels, kind):
