@@ -130,3 +130,31 @@ def test_compiled_sees_committed_only(g):
     # new source → fresh snapshot sees the commit
     after = g.traversal().with_computer("tpu").V().both().count().to_list()[0]
     assert after >= before
+
+
+def test_start_dedup_collapses_duplicates(g):
+    # dedup() before any vertex step must dedup the start multiset
+    tx = g.new_transaction()
+    vid = next(iter(tx.query().vertices())).id
+    tx.rollback()
+    oltp, tpu = _both(g, lambda t: t.V(vid, vid).dedup().count())
+    assert oltp == tpu == [1]
+    oltp, tpu = _both(g, lambda t: t.V(vid, vid).dedup().out().count())
+    assert oltp == tpu
+
+
+def test_label_filter_without_codes_raises(g):
+    # an explicitly supplied snapshot IS the dataset: if it lacks label
+    # codes, a label-filtered step must raise — silently traversing every
+    # edge (or silently answering from the live graph) would be wrong data
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    full = snap_mod.build(g)
+    stripped = snap_mod.from_arrays(full.n, full.src, full.dst,
+                                    full.vertex_ids)
+    with pytest.raises(ValueError, match="label"):
+        (g.traversal().with_computer("tpu", snapshot=stripped)
+         .V().out("knows").count().to_list())
+    # unfiltered steps on the same snapshot still run on the device
+    got = (g.traversal().with_computer("tpu", snapshot=stripped)
+           .V().out().count().to_list())
+    assert got == g.traversal().V().out().count().to_list()

@@ -1,0 +1,153 @@
+"""Native (C++) host-side kernels, loaded via ctypes.
+
+The compute plane is JAX/XLA/Pallas on the device; the *host* hot paths —
+edge-column decode during CSR snapshot ingest and CSR index construction —
+are compiled C++ (src/titan_native.cpp), mirroring the role the reference's
+JVM gave its serializer hot loops (reference: titan-core
+graphdb/database/EdgeSerializer.java:73-166, util/StaticArrayEntryList.java).
+
+Import contract: ``available`` is True iff the shared library loaded.  On
+first import the library is built with the local C++ toolchain if missing or
+stale; any failure degrades silently to the pure-numpy fallbacks (set
+``TITAN_TPU_NO_NATIVE=1`` to force the fallback, e.g. in tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "titan_native.cpp")
+_SO = os.path.join(_DIR, "_titan_native.so")
+
+KIND_SKIP = 0
+KIND_OUT_EDGE = 1
+KIND_EXISTS = 3
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> bool:
+    # compile to a process-unique temp name, then atomically rename: a
+    # concurrent importer either sees the old/absent file or the complete
+    # new one, never a half-written library
+    cxx = os.environ.get("CXX", "g++")
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-o", tmp, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        if proc.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("TITAN_TPU_NO_NATIVE"):
+        return None
+    stale = (not os.path.exists(_SO)
+             or (os.path.exists(_SRC)
+                 and os.path.getmtime(_SRC) > os.path.getmtime(_SO)))
+    if stale and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+        return _bind(lib)
+    except (OSError, AttributeError):
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    i64 = ctypes.c_int64
+    lib.tt_bulk_read_uvar.restype = i64
+    lib.tt_bulk_read_uvar.argtypes = [u8p, i64, i64p, i64, i64p, i64p]
+    lib.tt_parse_heads.restype = i64
+    lib.tt_parse_heads.argtypes = [u8p, i64, i64p, i64, u8p, i64,
+                                   np.ctypeslib.ndpointer(
+                                       np.uint8, flags="C_CONTIGUOUS,WRITEABLE"),
+                                   i64p, i64p]
+    lib.tt_csr_build.restype = None
+    lib.tt_csr_build.argtypes = [i32p, i32p, i64, i64, i64p, i64p, i32p, i64p]
+    lib.tt_gather_i32.restype = None
+    lib.tt_gather_i32.argtypes = [i32p, i64p, i64, i32p]
+    lib.tt_abi_version.restype = ctypes.c_int
+    if lib.tt_abi_version() != 1:
+        return None
+    return lib
+
+
+_lib = _load()
+available = _lib is not None
+
+
+def bulk_read_uvar(data: np.ndarray, offsets: np.ndarray
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """Decode one varint at each offset; returns (values, end_offsets)."""
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    m = len(offsets)
+    values = np.empty(m, dtype=np.int64)
+    ends = np.empty(m, dtype=np.int64)
+    rc = _lib.tt_bulk_read_uvar(data, len(data), offsets, m, values, ends)
+    if rc != m:
+        raise ValueError(f"corrupt varint at entry {~rc}")
+    return values, ends
+
+
+def parse_heads(cols: np.ndarray, offs: np.ndarray, exists_prefix: bytes
+                ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Classify each column entry; returns (kind u8, type_count i64,
+    data_pos i64) — see KIND_* constants."""
+    cols = np.ascontiguousarray(cols, dtype=np.uint8)
+    offs = np.ascontiguousarray(offs, dtype=np.int64)
+    m = len(offs) - 1
+    kind = np.empty(m, dtype=np.uint8)
+    type_count = np.empty(m, dtype=np.int64)
+    data_pos = np.empty(m, dtype=np.int64)
+    ep = np.frombuffer(exists_prefix, dtype=np.uint8) if exists_prefix \
+        else np.empty(0, dtype=np.uint8)
+    ep = np.ascontiguousarray(ep)
+    rc = _lib.tt_parse_heads(cols, len(cols), offs, m, ep, len(ep),
+                             kind, type_count, data_pos)
+    if rc != m:
+        raise ValueError(f"corrupt column head at entry {~rc}")
+    return kind, type_count, data_pos
+
+
+def csr_build(src: np.ndarray, dst: np.ndarray, n: int
+              ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stable sort-by-dst permutation + CSR indptr + out-degrees.
+    Returns (order i64[E], indptr i64[n+1], out_degree i32[n])."""
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    e = len(src)
+    order = np.empty(e, dtype=np.int64)
+    indptr = np.empty(n + 1, dtype=np.int64)
+    out_degree = np.empty(n, dtype=np.int32)
+    scratch = np.empty(n + 1, dtype=np.int64)
+    _lib.tt_csr_build(src, dst, e, n, order, indptr, out_degree, scratch)
+    return order, indptr, out_degree
+
+
+def gather_i32(values: np.ndarray, order: np.ndarray) -> np.ndarray:
+    values = np.ascontiguousarray(values, dtype=np.int32)
+    order = np.ascontiguousarray(order, dtype=np.int64)
+    out = np.empty(len(order), dtype=np.int32)
+    _lib.tt_gather_i32(values, order, len(order), out)
+    return out

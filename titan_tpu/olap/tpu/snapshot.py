@@ -21,6 +21,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from titan_tpu import native
 from titan_tpu.codec import relation_ids as rids
 from titan_tpu.core.defs import Direction, RelationCategory
 from titan_tpu.storage.api import SliceQuery
@@ -67,20 +68,133 @@ def from_arrays(n: int, src, dst, vertex_ids=None, edge_values=None,
     """Build a snapshot from raw (src, dst) dense-index arrays."""
     src = np.asarray(src, dtype=np.int32)
     dst = np.asarray(dst, dtype=np.int32)
+    if len(src) and (int(src.min()) < 0 or int(src.max()) >= n
+                     or int(dst.min()) < 0 or int(dst.max()) >= n):
+        raise IndexError(f"edge endpoint out of range [0, {n})")
     if vertex_ids is None:
         vertex_ids = np.arange(n, dtype=np.int64)
-    order = np.argsort(dst, kind="stable")
-    src_s, dst_s = src[order], dst[order]
+    if native.available and n > 0:
+        order, indptr, out_degree = native.csr_build(src, dst, n)
+        src_s = native.gather_i32(src, order)
+        dst_s = native.gather_i32(dst, order)
+    else:
+        order = np.argsort(dst, kind="stable")
+        src_s, dst_s = src[order], dst[order]
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.add.at(indptr, dst_s + 1, 1)
+        np.cumsum(indptr, out=indptr)
+        out_degree = np.zeros(n, dtype=np.int32)
+        np.add.at(out_degree, src, 1)
     ev = {k: np.asarray(v)[order] for k, v in (edge_values or {}).items()}
     lab = np.asarray(labels, dtype=np.int32)[order] if labels is not None else None
-    indptr = np.zeros(n + 1, dtype=np.int64)
-    np.add.at(indptr, dst_s + 1, 1)
-    np.cumsum(indptr, out=indptr)
-    out_degree = np.zeros(n, dtype=np.int32)
-    np.add.at(out_degree, src, 1)
     return GraphSnapshot(n, np.asarray(vertex_ids, dtype=np.int64), src_s,
                          dst_s, indptr, out_degree, ev, lab,
                          dict(label_names or {}))
+
+
+def _scan_python(graph, rows, exists_q, scan_q, label_ids, key_ids):
+    """Per-entry decode via the Python codec (fallback; also the path when
+    edge property values must be extracted)."""
+    idm, schema, codec = graph.idm, graph.schema, graph.codec
+    srcs: list[int] = []
+    dsts: list[int] = []
+    labs: list[int] = []
+    ev: dict[str, list] = {name: [] for name in key_ids.values()}
+    vertex_id_list: list[int] = []
+    for key, entries in rows:
+        vid = idm.id_of_key_bytes(key)
+        if not idm.is_user_vertex_id(vid):
+            continue
+        has_exist = False
+        for e in entries:
+            if exists_q.contains(e.column):
+                has_exist = True
+            elif scan_q.contains(e.column):
+                rc = codec.parse(e, schema)
+                if rc.direction is not Direction.OUT or not rc.is_edge:
+                    continue
+                if schema.system.is_system(rc.type_id):
+                    continue
+                if label_ids is not None and rc.type_id not in label_ids:
+                    continue
+                srcs.append(vid)
+                dsts.append(rc.other_vertex_id)
+                labs.append(idm.count(rc.type_id))
+                for kid, name in key_ids.items():
+                    ev[name].append(rc.properties.get(kid, 0))
+        if has_exist:
+            vertex_id_list.append(vid)
+    return vertex_id_list, srcs, dsts, labs, ev
+
+
+def _scan_native(graph, rows, exists_q, label_ids):
+    """Bulk decode via the C++ codec (native/): Python only concatenates
+    column bytes; head classification and other-vertex varint decode run as
+    two vectorized native sweeps. Labels whose columns carry sort keys or
+    park the other-vertex id in the value (unique directions) fall back to
+    per-entry Python parse — rare, and only for those entries."""
+    from titan_tpu.ids import IDType
+    idm, schema, codec = graph.idm, graph.schema, graph.codec
+
+    cols = bytearray()
+    offs: list[int] = [0]
+    entry_row: list[int] = []
+    entry_refs: list = []
+    row_vids: list[int] = []
+    for key, entries in rows:
+        vid = idm.id_of_key_bytes(key)
+        if not idm.is_user_vertex_id(vid):
+            continue
+        ridx = len(row_vids)
+        row_vids.append(vid)
+        for e in entries:
+            cols += e.column
+            offs.append(len(cols))
+            entry_row.append(ridx)
+            entry_refs.append(e)
+
+    if not entry_refs:
+        return [], np.empty(0, np.int64), np.empty(0, np.int64), [], {}
+
+    col_buf = np.frombuffer(cols, dtype=np.uint8)  # zero-copy view
+    kind, tcount, dpos = native.parse_heads(
+        col_buf, np.asarray(offs, dtype=np.int64), exists_q.start)
+    entry_row_a = np.asarray(entry_row, dtype=np.int64)
+    row_vids_a = np.asarray(row_vids, dtype=np.int64)
+
+    exists_rows = np.unique(entry_row_a[kind == native.KIND_EXISTS])
+    vertex_id_list = row_vids_a[exists_rows].tolist()
+
+    edge_mask = kind == native.KIND_OUT_EDGE
+    keep_counts, fast_counts = [], []
+    for c in np.unique(tcount[edge_mask]).tolist():
+        tid = idm.schema_id(IDType.USER_EDGE_LABEL, int(c))
+        if label_ids is not None and tid not in label_ids:
+            continue
+        keep_counts.append(c)
+        if (not schema.sort_key(tid)
+                and not schema.multiplicity(tid).unique(Direction.OUT)):
+            fast_counts.append(c)
+    keep = edge_mask & np.isin(tcount, keep_counts)
+    fast = keep & np.isin(tcount, fast_counts)
+
+    others, _ = native.bulk_read_uvar(col_buf, dpos[fast])
+    srcs = row_vids_a[entry_row_a[fast]]
+    dsts = others
+    labs = tcount[fast].astype(np.int64)
+
+    slow_idx = np.flatnonzero(keep & ~fast)
+    if len(slow_idx):
+        s_src, s_dst, s_lab = [], [], []
+        for i in slow_idx.tolist():
+            rc = codec.parse(entry_refs[i], schema)
+            s_src.append(row_vids_a[entry_row_a[i]])
+            s_dst.append(rc.other_vertex_id)
+            s_lab.append(idm.count(rc.type_id))
+        srcs = np.concatenate([srcs, np.asarray(s_src, np.int64)])
+        dsts = np.concatenate([dsts, np.asarray(s_dst, np.int64)])
+        labs = np.concatenate([labs, np.asarray(s_lab, np.int64)])
+    return vertex_id_list, srcs, dsts, labs.tolist(), {}
 
 
 def build(graph, labels: Optional[Sequence[str]] = None,
@@ -109,40 +223,18 @@ def build(graph, labels: Optional[Sequence[str]] = None,
                                   include_system=False)
     scan_q = SliceQuery(lo, hi)
 
-    srcs: list[int] = []
-    dsts: list[int] = []
-    labs: list[int] = []
-    ev: dict[str, list] = {name: [] for name in key_ids.values()}
-    vertex_id_list: list[int] = []
-
     btx = graph.backend.begin_transaction()
     try:
         exists_q = codec.query_type(schema.system.vertex_exists, Direction.OUT,
                                     schema)[0]
-        for key, entries in graph.backend.edge_store.store.get_keys(
-                SliceQuery(), btx.store_tx):
-            vid = idm.id_of_key_bytes(key)
-            if not idm.is_user_vertex_id(vid):
-                continue
-            has_exist = False
-            for e in entries:
-                if exists_q.contains(e.column):
-                    has_exist = True
-                elif scan_q.contains(e.column):
-                    rc = codec.parse(e, schema)
-                    if rc.direction is not Direction.OUT or not rc.is_edge:
-                        continue
-                    if schema.system.is_system(rc.type_id):
-                        continue
-                    if label_ids is not None and rc.type_id not in label_ids:
-                        continue
-                    srcs.append(vid)
-                    dsts.append(rc.other_vertex_id)
-                    labs.append(idm.count(rc.type_id))
-                    for kid, name in key_ids.items():
-                        ev[name].append(rc.properties.get(kid, 0))
-            if has_exist:
-                vertex_id_list.append(vid)
+        rows = graph.backend.edge_store.store.get_keys(SliceQuery(),
+                                                       btx.store_tx)
+        if native.available and not key_ids:
+            vertex_id_list, srcs, dsts, labs, ev = _scan_native(
+                graph, rows, exists_q, label_ids)
+        else:
+            vertex_id_list, srcs, dsts, labs, ev = _scan_python(
+                graph, rows, exists_q, scan_q, label_ids, key_ids)
     finally:
         btx.commit()
 

@@ -285,10 +285,14 @@ class Traversal:
         # OLAP compilation: a supported V().has(...).out()...count() chain on
         # the tpu computer runs as CSR supersteps instead of interpretation
         if self.source._computer == "tpu":
-            from titan_tpu.traversal.olap_compile import try_compile
+            from titan_tpu.traversal.olap_compile import (FallbackToInterpreter,
+                                                          try_compile)
             compiled = try_compile(steps, self.source)
             if compiled is not None:
-                return compiled.run()
+                try:
+                    return compiled.run()
+                except FallbackToInterpreter:
+                    pass
 
         traversers: Iterable[Traverser] = iter(())
         i = 0

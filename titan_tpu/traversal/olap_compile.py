@@ -28,18 +28,39 @@ import numpy as np
 from titan_tpu.core.defs import Direction
 
 
+class FallbackToInterpreter(Exception):
+    """Raised at execution time when the snapshot can't answer the compiled
+    plan faithfully (e.g. label filters but no label codes); the caller
+    reruns the traversal on the OLTP interpreter."""
+
+
 class CompiledTraversal:
-    def __init__(self, source, start, vsteps, terminal):
+    def __init__(self, source, start, vsteps, terminal, dedup_start=False):
         self.source = source
         self.start = start          # ("all",) | ("ids", ids) | ("query", conds)
         self.vsteps = vsteps        # [(direction, label_names|None, dedup?)]
         self.terminal = terminal    # "count" | "id" | "vertices"
+        self.dedup_start = dedup_start
 
     # -- execution -----------------------------------------------------------
 
     def run(self) -> Iterator:
+        explicit = self.source._snapshot is not None
         snap = self._snapshot()
+        if snap.labels is None and any(labels for _, labels, _ in self.vsteps):
+            if explicit:
+                # a user-supplied snapshot IS the dataset; answering from the
+                # live graph instead would silently switch datasets
+                raise ValueError(
+                    "label-filtered traversal on a snapshot built without "
+                    "label codes; rebuild it with snapshot.build(graph) or "
+                    "pass labels/label_names to from_arrays")
+            raise FallbackToInterpreter(
+                "snapshot has no edge-label codes; label-filtered steps "
+                "cannot run on the device")
         counts0 = self._start_counts(snap)
+        if self.dedup_start:
+            np.minimum(counts0, 1, out=counts0)
         plan = []
         for direction, labels, dedup_after in self.vsteps:
             mask = self._label_mask(snap, labels)
@@ -95,8 +116,6 @@ class CompiledTraversal:
     def _label_mask(self, snap, labels) -> Optional[np.ndarray]:
         if not labels:
             return None
-        if snap.labels is None:
-            return None   # snapshot built without label codes: no filtering
         wanted = {code for code, name in snap.label_names.items()
                   if name in labels}
         return np.isin(snap.labels, np.array(sorted(wanted), dtype=np.int32))
@@ -177,6 +196,7 @@ def try_compile(steps: list, source) -> Optional[CompiledTraversal]:
 
     vsteps = []
     terminal = "vertices"
+    dedup_start = False
     while i < len(steps):
         name, args = steps[i]
         if name == "vstep":
@@ -198,6 +218,8 @@ def try_compile(steps: list, source) -> Optional[CompiledTraversal]:
         elif name == "dedup":
             if vsteps:
                 vsteps[-1][2] = True
+            else:
+                dedup_start = True
             i += 1
         elif name == "count":
             if i != len(steps) - 1:
@@ -214,4 +236,5 @@ def try_compile(steps: list, source) -> Optional[CompiledTraversal]:
     if not vsteps and terminal == "vertices":
         return None   # no device work: let the interpreter answer
     return CompiledTraversal(source, start,
-                             [tuple(s) for s in vsteps], terminal)
+                             [tuple(s) for s in vsteps], terminal,
+                             dedup_start=dedup_start)
