@@ -202,6 +202,10 @@ QUERY_BATCH = ConfigOption(
 SMART_LIMIT = ConfigOption(
     QUERY_NS, "smart-limit", "guess small limits for interactive queries",
     bool, True, Mutability.MASKABLE)
+FAST_PROPERTY = ConfigOption(
+    QUERY_NS, "fast-property",
+    "prefetch all properties on first single-property access",
+    bool, True, Mutability.MASKABLE)
 
 # --- metrics ----------------------------------------------------------------
 METRICS_NS = ConfigNamespace(ROOT, "metrics", "metrics collection")

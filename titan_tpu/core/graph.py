@@ -85,6 +85,11 @@ class StandardGraph:
         for name in config.container_names(d.INDEX_NS):
             self._open_index_provider(name)
         self._commit_lock = threading.Lock()
+        self._metrics = None
+        self._metrics_prefix = config.get(d.METRICS_PREFIX) or "titan_tpu"
+        if config.get(d.BASIC_METRICS):
+            from titan_tpu.utils.metrics import MetricManager
+            self._metrics = MetricManager.instance()
 
     # -- mixed index providers ----------------------------------------------
 
@@ -120,7 +125,14 @@ class StandardGraph:
 
     def new_transaction(self, read_only: bool = False) -> GraphTransaction:
         self._check_open()
+        self.count_tx("begin")
         return GraphTransaction(self, read_only=read_only)
+
+    def count_tx(self, event: str) -> None:
+        """tx begin/commit/rollback counters (reference: docs/monitoring.txt:7-12
+        measured domains; counters live in the shared MetricManager)."""
+        if self._metrics is not None:
+            self._metrics.counter(f"{self._metrics_prefix}.tx.{event}").inc()
 
     def tx(self) -> GraphTransaction:
         """Thread-bound current transaction (reference: thread-bound tx in

@@ -50,6 +50,14 @@ class GraphTransaction:
         self._added: dict[int, InternalRelation] = {}        # rel id -> rel
         self._deleted: dict[int, InternalRelation] = {}      # rel id -> rel
         self._added_by_vertex: dict[int, list] = {}          # vid -> [rel]
+        # per-vertex slice cache with query subsumption (reference:
+        # CacheVertex — loaded EntryLists are reused within the tx; deltas
+        # are merged on top by _iter_relations, so no invalidation needed)
+        self._slice_cache: dict[bytes, list] = {}   # key -> [(SliceQuery, entries)]
+        self._slice_cache_size = 0
+        from titan_tpu.config import defaults as _d
+        self._slice_cache_cap = graph.config.get(_d.TX_CACHE_SIZE)
+        self._fast_property = graph.config.get(_d.FAST_PROPERTY)
         from titan_tpu.storage.locking import LockState
         self._lock_state = LockState()
 
@@ -69,6 +77,22 @@ class GraphTransaction:
     @property
     def is_open(self) -> bool:
         return self._open
+
+    def edge_query(self, ksq) -> list:
+        """Edgestore slice read through the per-tx vertex slice cache
+        (reference: CacheVertex.loadRelations — an already-loaded slice that
+        subsumes the request answers it without a backend call)."""
+        cached = self._slice_cache.get(ksq.key)
+        if cached is not None:
+            for q, entries in cached:
+                if q.subsumes(ksq.slice):
+                    from titan_tpu.storage.api import apply_slice
+                    return apply_slice(entries, ksq.slice)
+        entries = self.backend_tx.edge_store_query(ksq)
+        if self._slice_cache_size < self._slice_cache_cap:
+            self._slice_cache.setdefault(ksq.key, []).append((ksq.slice, entries))
+            self._slice_cache_size += len(entries) + 1
+        return entries
 
     def vertex_handle(self, vid: int) -> Vertex:
         v = self._vertices.get(vid)
@@ -252,8 +276,7 @@ class GraphTransaction:
     def _vertex_exists(self, vid: int) -> bool:
         [q] = self.codec.query_type(self.schema.system.vertex_exists,
                                     Direction.OUT, self.schema)
-        entries = self.backend_tx.edge_store_query(
-            KeySliceQuery(self.idm.key_bytes(vid), q))
+        entries = self.edge_query(KeySliceQuery(self.idm.key_bytes(vid), q))
         return bool(entries)
 
     def vertices(self) -> Iterator[Vertex]:
@@ -304,6 +327,16 @@ class GraphTransaction:
                     type_ids.append(st.id)
             if not type_ids:
                 return
+            if self._fast_property and vid not in self._new_vertices and \
+                    self._slice_cache_size < self._slice_cache_cap:
+                # property prefetch (reference: query.fast-property,
+                # StandardTitanTx — load the whole property slice once so
+                # subsequent single-key reads answer from the tx cache)
+                self.edge_query(KeySliceQuery(
+                    self.idm.key_bytes(vid),
+                    self.codec.query_category(RelationCategory.PROPERTY,
+                                              Direction.OUT,
+                                              include_system=False)))
         for rel in self._iter_relations(vid, Direction.OUT, type_ids,
                                         RelationCategory.PROPERTY):
             yield VertexProperty(self, rel)
@@ -381,7 +414,7 @@ class GraphTransaction:
                           include_system) -> Iterator[InternalRelation]:
         key = self.idm.key_bytes(vid)
         for q in self._slices_for(direction, type_ids, category, include_system):
-            for entry in self.backend_tx.edge_store_query(KeySliceQuery(vid_key := key, q)):
+            for entry in self.edge_query(KeySliceQuery(vid_key := key, q)):
                 rc = self.codec.parse(entry, self.schema)
                 rel = self._relation_from_cache(vid, rc)
                 if self._matches(rel, vid, direction, type_ids, category,
@@ -419,7 +452,28 @@ class GraphTransaction:
                                   False):
             if not keys:
                 break
-            result = self.backend_tx.edge_store_multi_query(list(keys), q)
+            # answer cached keys from the tx slice cache; batch only the rest
+            result = {}
+            misses = []
+            from titan_tpu.storage.api import apply_slice
+            for kb in keys:
+                hit = None
+                for cq, entries in self._slice_cache.get(kb, ()):
+                    if cq.subsumes(q):
+                        hit = apply_slice(entries, q)
+                        break
+                if hit is None:
+                    misses.append(kb)
+                else:
+                    result[kb] = hit
+            if misses:
+                fetched = self.backend_tx.edge_store_multi_query(misses, q)
+                result.update(fetched)
+                for kb in misses:
+                    if self._slice_cache_size < self._slice_cache_cap:
+                        entries = fetched.get(kb, [])
+                        self._slice_cache.setdefault(kb, []).append((q, entries))
+                        self._slice_cache_size += len(entries) + 1
             for kb, entries in result.items():
                 vid = keys[kb]
                 for entry in entries:
@@ -449,17 +503,22 @@ class GraphTransaction:
 
     def commit(self) -> None:
         self._check_open()
+        self.graph.count_tx("commit")
         try:
             if self._added or self._deleted:
                 self.graph.commit_transaction(self)
             elif self._backend_tx is not None:
                 self._backend_tx.commit()
+        except BaseException:
+            self.graph.count_tx("commit.exceptions")
+            raise
         finally:
             self._open = False
 
     def rollback(self) -> None:
         if not self._open:
             return
+        self.graph.count_tx("rollback")
         try:
             if self._backend_tx is not None:
                 self._backend_tx.rollback()

@@ -35,10 +35,10 @@ _lib: Optional[ctypes.CDLL] = None
 def _build() -> bool:
     # compile to a process-unique temp name, then atomically rename: a
     # concurrent importer either sees the old/absent file or the complete
-    # new one, never a half-written library
-    cxx = os.environ.get("CXX", "g++")
+    # new one, never a half-written library. The build recipe lives in the
+    # Makefile (single source of truth); SO= overrides the output name.
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-o", tmp, _SRC]
+    cmd = ["make", "-s", "-C", _DIR, f"SO={os.path.basename(tmp)}"]
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
         if proc.returncode != 0 or not os.path.exists(tmp):
@@ -76,7 +76,7 @@ def _bind(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
     i64 = ctypes.c_int64
     lib.tt_bulk_read_uvar.restype = i64
-    lib.tt_bulk_read_uvar.argtypes = [u8p, i64, i64p, i64, i64p, i64p]
+    lib.tt_bulk_read_uvar.argtypes = [u8p, i64, i64p, i64p, i64, i64p, i64p]
     lib.tt_parse_heads.restype = i64
     lib.tt_parse_heads.argtypes = [u8p, i64, i64p, i64, u8p, i64,
                                    np.ctypeslib.ndpointer(
@@ -87,7 +87,7 @@ def _bind(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
     lib.tt_gather_i32.restype = None
     lib.tt_gather_i32.argtypes = [i32p, i64p, i64, i32p]
     lib.tt_abi_version.restype = ctypes.c_int
-    if lib.tt_abi_version() != 1:
+    if lib.tt_abi_version() != 2:
         return None
     return lib
 
@@ -96,15 +96,23 @@ _lib = _load()
 available = _lib is not None
 
 
-def bulk_read_uvar(data: np.ndarray, offsets: np.ndarray
+def bulk_read_uvar(data: np.ndarray, offsets: np.ndarray,
+                   bounds: Optional[np.ndarray] = None
                    ) -> tuple[np.ndarray, np.ndarray]:
-    """Decode one varint at each offset; returns (values, end_offsets)."""
+    """Decode one varint at each offset; returns (values, end_offsets).
+    ``bounds[i]`` is the end of the entry owning offset i — decoding must
+    not cross it (defaults to end-of-buffer)."""
     data = np.ascontiguousarray(data, dtype=np.uint8)
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     m = len(offsets)
+    if bounds is None:
+        bounds = np.full(m, len(data), dtype=np.int64)
+    else:
+        bounds = np.ascontiguousarray(bounds, dtype=np.int64)
     values = np.empty(m, dtype=np.int64)
     ends = np.empty(m, dtype=np.int64)
-    rc = _lib.tt_bulk_read_uvar(data, len(data), offsets, m, values, ends)
+    rc = _lib.tt_bulk_read_uvar(data, len(data), offsets, bounds, m, values,
+                                ends)
     if rc != m:
         raise ValueError(f"corrupt varint at entry {~rc}")
     return values, ends

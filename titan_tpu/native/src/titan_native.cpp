@@ -27,11 +27,17 @@ constexpr int kPrefixBits = 3;
 constexpr int kDelta = 8 - kPrefixBits;  // value bits below the prefix in byte0
 
 // Decodes one MSB-first unsigned varint; returns new position or -1 on
-// truncation/overrun.
+// truncation/overrun. Capped at 10 seven-bit groups (mirrors
+// utils/varint.py's unterminated-varint guard: ids are <= 63 bits, so more
+// groups means corruption — error instead of wrapping).
+constexpr int kMaxGroups = 10;
+
 inline int64_t read_uvar(const uint8_t* p, int64_t pos, int64_t end,
                          int64_t* out) {
   uint64_t v = 0;
+  int groups = 0;
   while (pos < end) {
+    if (++groups > kMaxGroups) return -1;
     uint8_t b = p[pos++];
     v = (v << 7) | (b & kMask);
     if (b & kStop) {
@@ -65,14 +71,17 @@ inline int64_t read_uvar_prefixed(const uint8_t* p, int64_t pos, int64_t end,
 
 extern "C" {
 
-// Bulk MSB-first varint decode: one varint starting at each offsets[i].
-// Fills values[i] and ends[i] (position after the varint). Returns the
-// number decoded, or ~i (bitwise-not of the failing index) on corruption.
+// Bulk MSB-first varint decode: one varint starting at each offsets[i],
+// bounded by bounds[i] (the owning entry's end — a varint must not run past
+// its entry into the next column's bytes). Fills values[i] and ends[i]
+// (position after the varint). Returns the number decoded, or ~i
+// (bitwise-not of the failing index) on corruption.
 int64_t tt_bulk_read_uvar(const uint8_t* data, int64_t data_len,
-                          const int64_t* offsets, int64_t m, int64_t* values,
-                          int64_t* ends) {
+                          const int64_t* offsets, const int64_t* bounds,
+                          int64_t m, int64_t* values, int64_t* ends) {
   for (int64_t i = 0; i < m; ++i) {
-    int64_t end = read_uvar(data, offsets[i], data_len, &values[i]);
+    int64_t bound = bounds[i] < data_len ? bounds[i] : data_len;
+    int64_t end = read_uvar(data, offsets[i], bound, &values[i]);
     if (end < 0) return ~i;
     ends[i] = end;
   }
@@ -145,6 +154,6 @@ void tt_gather_i32(const int32_t* in, const int64_t* order, int64_t e,
   for (int64_t i = 0; i < e; ++i) out[i] = in[order[i]];
 }
 
-int tt_abi_version(void) { return 1; }
+int tt_abi_version(void) { return 2; }
 
 }  // extern "C"

@@ -178,7 +178,8 @@ def _scan_native(graph, rows, exists_q, label_ids):
     keep = edge_mask & np.isin(tcount, keep_counts)
     fast = keep & np.isin(tcount, fast_counts)
 
-    others, _ = native.bulk_read_uvar(col_buf, dpos[fast])
+    entry_ends = np.asarray(offs, dtype=np.int64)[1:]
+    others, _ = native.bulk_read_uvar(col_buf, dpos[fast], entry_ends[fast])
     srcs = row_vids_a[entry_row_a[fast]]
     dsts = others
     labs = tcount[fast].astype(np.int64)

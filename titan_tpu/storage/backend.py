@@ -46,6 +46,13 @@ class Backend:
                 backend_name,
                 directory=config.get(d.STORAGE_DIRECTORY),
                 read_only=config.get(d.STORAGE_READONLY))
+        # metrics wrapping sits directly over the raw manager so every opened
+        # store is instrumented, and the expiration cache layers ABOVE it —
+        # cache hits don't count as backend ops (reference: Backend.java:142-146)
+        if config is not None and config.get(d.BASIC_METRICS):
+            from titan_tpu.utils.metrics import MetricInstrumentedStoreManager
+            manager = MetricInstrumentedStoreManager(
+                manager, prefix=config.get(d.METRICS_PREFIX) or "titan_tpu")
         self.manager = manager
         self.instance_id = instance_id
 

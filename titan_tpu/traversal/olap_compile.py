@@ -47,7 +47,11 @@ class CompiledTraversal:
     def run(self) -> Iterator:
         explicit = self.source._snapshot is not None
         snap = self._snapshot()
-        if snap.labels is None and any(labels for _, labels, _ in self.vsteps):
+        no_codes = snap.labels is None or (
+            # label codes without a code→name map are just as unanswerable
+            # for a name-filtered step — don't silently match nothing
+            not snap.label_names)
+        if no_codes and any(labels for _, labels, _ in self.vsteps):
             if explicit:
                 # a user-supplied snapshot IS the dataset; answering from the
                 # live graph instead would silently switch datasets

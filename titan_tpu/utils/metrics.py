@@ -1,0 +1,295 @@
+"""Metrics: counters/timers registry + instrumented store wrappers.
+
+(reference: titan-core util/stats/MetricManager.java:1-395 — a Dropwizard
+registry singleton with console/CSV/JMX/... reporters; and
+diskstorage/util/MetricInstrumentedStore.java — every store call wrapped in
+a timer + counter + failure counter, wired at Backend.java:142-146. The
+measured domains are documented in docs/monitoring.txt:7-12: per-op
+attempts/failures/latency. The reference additionally asserts exact backend
+call counts as a perf-regression guard in TitanOperationCountingTest — the
+rebuild keeps that contract via ``MetricManager.counter_value``.)
+
+TPU-first notes: the registry is pure host-side bookkeeping (nanosecond
+timers around store RPCs); device-side timing comes from JAX profiling, not
+from here. The instrumented wrapper sits *under* the expiration cache so
+cache hits do not count as backend ops — exactly the reference's layering.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Sequence
+
+from titan_tpu.storage.api import (Entry, KeyColumnValueStore,
+                                   KeyColumnValueStoreManager, KeySliceQuery,
+                                   SliceQuery, StoreTransaction)
+
+# merged-store metric naming: per-store metrics roll up under these merged
+# names exactly like the reference (reference: Backend.java:83-86
+# METRICS_MERGED_STORE / METRICS_MERGED_CACHE)
+MERGED_STORE = "storeManager"
+MERGED_CACHE = "cache"
+
+M_CALLS = "calls"
+M_TIME = "time"
+M_EXCEPTIONS = "exceptions"
+M_ENTRIES_COUNT = "entries-returned"
+
+
+@dataclass
+class Counter:
+    count: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.count += n
+
+
+@dataclass
+class Timer:
+    """Latency accumulator: count, total/min/max nanoseconds."""
+    count: int = 0
+    total_ns: int = 0
+    min_ns: int = 0
+    max_ns: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def update(self, elapsed_ns: int) -> None:
+        with self._lock:
+            if self.count == 0 or elapsed_ns < self.min_ns:
+                self.min_ns = elapsed_ns
+            if elapsed_ns > self.max_ns:
+                self.max_ns = elapsed_ns
+            self.count += 1
+            self.total_ns += elapsed_ns
+
+    @property
+    def mean_ns(self) -> float:
+        return self.total_ns / self.count if self.count else 0.0
+
+
+class MetricManager:
+    """Named-metric registry. One shared default instance (the reference's
+    ``MetricManager.INSTANCE`` singleton), but independently constructible
+    for test isolation."""
+
+    _instance: Optional["MetricManager"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._counters: dict[str, Counter] = {}
+        self._timers: dict[str, Timer] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "MetricManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = MetricManager()
+            return cls._instance
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter())
+        return c
+
+    def timer(self, name: str) -> Timer:
+        t = self._timers.get(name)
+        if t is None:
+            with self._lock:
+                t = self._timers.setdefault(name, Timer())
+        return t
+
+    def counter_value(self, name: str) -> int:
+        c = self._counters.get(name)
+        return c.count if c is not None else 0
+
+    def timer_count(self, name: str) -> int:
+        t = self._timers.get(name)
+        return t.count if t is not None else 0
+
+    def snapshot(self) -> dict:
+        """{name: value} for counters, {name: {count, mean_ms, ...}} for
+        timers — the reporter payload."""
+        out: dict = {}
+        for name, c in sorted(self._counters.items()):
+            out[name] = c.count
+        for name, t in sorted(self._timers.items()):
+            out[name] = {"count": t.count,
+                         "mean_ms": t.mean_ns / 1e6,
+                         "min_ms": t.min_ns / 1e6,
+                         "max_ms": t.max_ns / 1e6,
+                         "total_ms": t.total_ns / 1e6}
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+
+    # -- reporters (reference: console/CSV reporters,
+    #    GraphDatabaseConfiguration.java:1010-1226) --------------------------
+
+    def report_console(self, out=None) -> str:
+        buf = io.StringIO()
+        for name, val in self.snapshot().items():
+            if isinstance(val, dict):
+                buf.write(f"{name}: count={val['count']} "
+                          f"mean={val['mean_ms']:.3f}ms max={val['max_ms']:.3f}ms\n")
+            else:
+                buf.write(f"{name}: {val}\n")
+        text = buf.getvalue()
+        if out is not None:
+            out.write(text)
+        return text
+
+    def report_csv(self, path: str) -> None:
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["metric", "count", "mean_ms", "min_ms", "max_ms"])
+            for name, val in self.snapshot().items():
+                if isinstance(val, dict):
+                    w.writerow([name, val["count"], f"{val['mean_ms']:.6f}",
+                                f"{val['min_ms']:.6f}", f"{val['max_ms']:.6f}"])
+                else:
+                    w.writerow([name, val, "", "", ""])
+
+
+class _OpRecorder:
+    __slots__ = ("_timer", "_calls", "_fails", "_t0")
+
+    def __init__(self, metrics: MetricManager, prefix: str, store: str, op: str):
+        base = f"{prefix}.{store}.{op}"
+        self._timer = metrics.timer(f"{base}.{M_TIME}")
+        self._calls = metrics.counter(f"{base}.{M_CALLS}")
+        self._fails = metrics.counter(f"{base}.{M_EXCEPTIONS}")
+        self._t0 = 0
+
+    def __enter__(self):
+        self._calls.inc()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._timer.update(time.perf_counter_ns() - self._t0)
+        if exc_type is not None:
+            self._fails.inc()
+        return False
+
+
+class MetricInstrumentedStore(KeyColumnValueStore):
+    """Wraps every store op in calls/time/exceptions metrics under both the
+    store's own name and the merged name (reference:
+    diskstorage/util/MetricInstrumentedStore.java)."""
+
+    def __init__(self, store: KeyColumnValueStore, prefix: str,
+                 metrics: Optional[MetricManager] = None,
+                 merged_name: Optional[str] = None):
+        self._store = store
+        self._prefix = prefix
+        self._metrics = metrics or MetricManager.instance()
+        self._merged = merged_name
+
+    @property
+    def name(self) -> str:
+        return self._store.name
+
+    @property
+    def wrapped(self) -> KeyColumnValueStore:
+        return self._store
+
+    def _rec(self, op: str):
+        return _OpRecorder(self._metrics, self._prefix,
+                           self._merged or self._store.name, op)
+
+    def get_slice(self, query: KeySliceQuery, txh: StoreTransaction):
+        with self._rec("getSlice"):
+            result = self._store.get_slice(query, txh)
+        self._metrics.counter(
+            f"{self._prefix}.{self._merged or self._store.name}"
+            f".getSlice.{M_ENTRIES_COUNT}").inc(len(result))
+        return result
+
+    def get_slice_multi(self, keys: Sequence[bytes], slice_query: SliceQuery,
+                        txh: StoreTransaction) -> dict:
+        with self._rec("getSliceMulti"):
+            return self._store.get_slice_multi(keys, slice_query, txh)
+
+    def mutate(self, key: bytes, additions: Sequence[Entry],
+               deletions: Sequence[bytes], txh: StoreTransaction) -> None:
+        with self._rec("mutate"):
+            self._store.mutate(key, additions, deletions, txh)
+
+    def acquire_lock(self, key: bytes, column: bytes, expected: Optional[bytes],
+                     txh: StoreTransaction) -> None:
+        with self._rec("acquireLock"):
+            self._store.acquire_lock(key, column, expected, txh)
+
+    def get_keys(self, query, txh: StoreTransaction) -> Iterator:
+        with self._rec("getKeys"):
+            it = self._store.get_keys(query, txh)
+        return it
+
+    def close(self) -> None:
+        self._store.close()
+
+
+class MetricInstrumentedStoreManager(KeyColumnValueStoreManager):
+    """Wraps opened stores + mutate_many (reference:
+    diskstorage/util/MetricInstrumentedStoreManager.java; merged-store
+    naming per Backend.java:83-86)."""
+
+    def __init__(self, manager: KeyColumnValueStoreManager, prefix: str,
+                 metrics: Optional[MetricManager] = None,
+                 merge_stores: bool = True):
+        self._manager = manager
+        self._prefix = prefix
+        self._metrics = metrics or MetricManager.instance()
+        self._merge = merge_stores
+
+    @property
+    def name(self) -> str:
+        return self._manager.name
+
+    @property
+    def features(self):
+        return self._manager.features
+
+    @property
+    def wrapped(self) -> KeyColumnValueStoreManager:
+        return self._manager
+
+    def open_database(self, name: str) -> KeyColumnValueStore:
+        store = self._manager.open_database(name)
+        merged = MERGED_STORE if self._merge else None
+        return MetricInstrumentedStore(store, self._prefix, self._metrics,
+                                       merged_name=merged)
+
+    def begin_transaction(self, config=None) -> StoreTransaction:
+        return self._manager.begin_transaction(config)
+
+    def mutate_many(self, mutations: dict, txh: StoreTransaction) -> None:
+        # unwrap: the inner manager must see its own stores
+        with _OpRecorder(self._metrics, self._prefix,
+                         MERGED_STORE if self._merge else self._manager.name,
+                         "mutateMany"):
+            self._manager.mutate_many(mutations, txh)
+
+    def get_local_key_partition(self):
+        return self._manager.get_local_key_partition()
+
+    def close(self) -> None:
+        self._manager.close()
+
+    def clear_storage(self) -> None:
+        self._manager.clear_storage()
+
+    def exists(self) -> bool:
+        return self._manager.exists()
