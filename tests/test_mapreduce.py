@@ -1,0 +1,186 @@
+"""MapReduce stage of the graph computers.
+
+Modeled on the reference's post-BSP MapReduce execution
+(FulgoraGraphComputer.java:192-246) with the PageRank/ShortestDistance
+MapReduce companions from titan-test as fixtures.
+"""
+
+import numpy as np
+import pytest
+
+import titan_tpu
+from titan_tpu import example
+from titan_tpu.olap.api import (DenseMapReduce, MapEmitter, MapReduce,
+                                ReduceEmitter, VertexProgram,
+                                execute_map_reduce)
+from titan_tpu.olap.computer import HostGraphComputer
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.olap.tpu.engine import TPUGraphComputer
+from titan_tpu.models import pagerank, sssp
+from titan_tpu.models.pagerank import TopRanksMapReduce
+from titan_tpu.models.sssp import MaxDistanceMapReduce
+
+
+# ---------------------------------------------------------------------------
+# the contract itself (no computer)
+# ---------------------------------------------------------------------------
+
+class _Obj:
+    def __init__(self, label, value):
+        self._label = label
+        self._value = value
+
+    def label(self):
+        return self._label
+
+    def get_state(self, key, default=None):
+        return self._value
+
+
+class CountByLabel(MapReduce):
+    memory_key = "countByLabel"
+
+    def map(self, vertex, emitter):
+        emitter.emit(vertex.label(), 1)
+
+    def combine(self, key, values, emitter):
+        emitter.emit(key, sum(values))
+
+    def reduce(self, key, values, emitter):
+        emitter.emit(key, sum(values))
+
+    def finalize(self, results):
+        return {k: v[0] for k, v in results.items()}
+
+
+def test_execute_map_reduce_groups_and_combines():
+    vertices = [_Obj("a", 0)] * 5 + [_Obj("b", 0)] * 3
+    out = execute_map_reduce(CountByLabel(), vertices, chunk=2)
+    assert out == {"a": 5, "b": 3}
+
+
+def test_map_reduce_default_reduce_passthrough():
+    class Identity(MapReduce):
+        def map(self, vertex, emitter):
+            emitter.emit("k", vertex.get_state("x"))
+
+    vertices = [_Obj("a", 0), _Obj("a", 0)]
+    out = execute_map_reduce(Identity(), vertices)
+    assert out == {"k": [0, 0]}
+
+
+# ---------------------------------------------------------------------------
+# host computer path
+# ---------------------------------------------------------------------------
+
+class InDegreeProgram(VertexProgram):
+    def execute(self, vertex, messenger, memory):
+        if memory.iteration == 0:
+            messenger.send(1, [n.id for n in vertex.out()])
+        else:
+            vertex.set_state("indeg", sum(messenger.receive()))
+
+    def terminate(self, memory):
+        return memory.iteration >= 1
+
+    def combiner(self):
+        return lambda a, b: a + b
+
+
+class MaxInDegree(MapReduce):
+    memory_key = "maxInDeg"
+
+    def map(self, vertex, emitter):
+        emitter.emit("max", vertex.get_state("indeg", 0))
+
+    def reduce(self, key, values, emitter):
+        emitter.emit(key, max(values))
+
+    def finalize(self, results):
+        return results["max"][0]
+
+
+def test_host_computer_map_reduce():
+    g = titan_tpu.open("inmemory")
+    example.load(g)
+    comp = HostGraphComputer(g, num_threads=4)
+    result = comp.run(InDegreeProgram(), map_reduces=[MaxInDegree()])
+    assert result.memory.get("maxInDeg") == 3   # jupiter
+    g.close()
+
+
+# ---------------------------------------------------------------------------
+# TPU computer path
+# ---------------------------------------------------------------------------
+
+def _random_snap(n=64, e=400, seed=3):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, e).astype(np.int32)
+    dst = rng.integers(0, n, e).astype(np.int32)
+    return snap_mod.from_arrays(n, src, dst)
+
+
+@pytest.mark.parametrize("ndev", [1, 8])
+def test_tpu_dense_map_reduce_top_ranks(ndev):
+    snap = _random_snap()
+    comp = TPUGraphComputer(snapshot=snap, num_devices=ndev)
+    inv = np.where(snap.out_degree > 0,
+                   1.0 / np.maximum(snap.out_degree, 1), 0.0).astype(np.float32)
+    res = comp.run(pagerank.PageRank(iterations=15),
+                   params={"n": snap.n, "inv_outdeg": inv},
+                   snapshot=snap, map_reduces=[TopRanksMapReduce(k=5)])
+    top = res.memory["pageRank"]
+    assert len(top) == 5
+    ranks = np.asarray(res["rank"])
+    best_dense = int(np.argmax(ranks))
+    assert top[0][0] == int(snap.vertex_ids[best_dense])
+    assert top[0][1] == pytest.approx(float(ranks.max()), rel=1e-5)
+    # descending order
+    vals = [r for _, r in top]
+    assert vals == sorted(vals, reverse=True)
+
+
+def test_tpu_classic_map_reduce_over_dense_state():
+    snap = _random_snap()
+    comp = TPUGraphComputer(snapshot=snap, num_devices=1)
+    inv = np.where(snap.out_degree > 0,
+                   1.0 / np.maximum(snap.out_degree, 1), 0.0).astype(np.float32)
+
+    class RankSum(MapReduce):
+        memory_key = "rankSum"
+
+        def map(self, vertex, emitter):
+            emitter.emit("sum", vertex.get_state("rank"))
+
+        def reduce(self, key, values, emitter):
+            emitter.emit(key, sum(values))
+
+        def finalize(self, results):
+            return results["sum"][0]
+
+    res = comp.run(pagerank.PageRank(iterations=10),
+                   params={"n": snap.n, "inv_outdeg": inv},
+                   snapshot=snap, map_reduces=[RankSum()])
+    assert res.memory["rankSum"] == pytest.approx(float(np.sum(res["rank"])),
+                                                  rel=1e-4)
+
+
+def test_sssp_max_distance_map_reduce():
+    snap = _random_snap(n=32, e=200)
+    comp = TPUGraphComputer(snapshot=snap, num_devices=1)
+    res = comp.run(sssp.SSSP(weight_key="w"),
+                   params={"source_dense": 0},
+                   snapshot=snap_with_weights(snap),
+                   map_reduces=[MaxDistanceMapReduce()])
+    m = res.memory["shortestDistance.max"]
+    d = np.asarray(res["dist"])
+    finite = d < 3.0e38
+    assert m == pytest.approx(float(d[finite].max()))
+
+
+def snap_with_weights(snap, seed=5):
+    rng = np.random.default_rng(seed)
+    e = len(np.asarray(snap.src))
+    w = rng.uniform(0.5, 2.0, e).astype(np.float32)
+    return snap_mod.from_arrays(snap.n, np.asarray(snap.src),
+                                np.asarray(snap.dst), edge_values={"w": w})

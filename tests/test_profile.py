@@ -1,0 +1,117 @@
+"""Query profiler + traversal .profile().
+
+Modeled on the reference's QueryProfiler threading
+(StandardTitanTx.java:1030,1116,1247) and Gremlin .profile() surfacing
+(TP3ProfileWrapper).
+"""
+
+import pytest
+
+import titan_tpu
+from titan_tpu.query.profile import NO_OP, QueryProfiler
+
+
+@pytest.fixture
+def graph():
+    g = titan_tpu.open("inmemory")
+    tx = g.new_transaction()
+    people = [tx.add_vertex("person", name=f"p{i}", age=20 + i)
+              for i in range(6)]
+    for i in range(5):
+        people[i].add_edge("knows", people[i + 1])
+    tx.commit()
+    yield g
+    g.close()
+
+
+def test_profiler_tree_and_render():
+    p = QueryProfiler()
+    with p.group("outer") as outer:
+        outer.annotate("k", 1)
+        with outer.group("inner"):
+            pass
+    assert p.children[0].name == "outer"
+    assert p.children[0].annotations["k"] == 1
+    assert p.children[0].children[0].name == "inner"
+    assert p.children[0].time_ns >= p.children[0].children[0].time_ns >= 0
+    text = p.render()
+    assert "outer" in text and "inner" in text and "k=1" in text
+    d = p.to_dict()
+    assert d["children"][0]["annotations"] == {"k": 1}
+
+
+def test_noop_profiler_is_inert():
+    before_children = len(NO_OP.children)
+    with NO_OP.group("x") as g:
+        g.annotate("a", 1)
+    assert len(NO_OP.children) == before_children
+    assert NO_OP.annotations == {}
+
+
+def test_graph_query_profiled_full_scan(graph):
+    p = QueryProfiler()
+    tx = graph.new_transaction()
+    from titan_tpu.query.graphquery import GraphQuery
+    res = GraphQuery(tx).with_profiler(p).has("age").vertices()
+    assert len(res) == 6
+    names = [c.name for c in p.children]
+    assert "optimization" in names
+    # no index on age -> full scan recorded
+    assert "full-scan" in names
+    scan = p.children[names.index("full-scan")]
+    assert scan.annotations["results"] == 6
+    tx.commit()
+
+
+def test_graph_query_profiled_indexed():
+    graph = titan_tpu.open("inmemory")
+    mgmt = graph.management()
+    name_key = mgmt.make_property_key("name", str)
+    mgmt.build_index("byName", "vertex").add_key(name_key).build_composite_index()
+    mgmt.commit()
+    tx0 = graph.new_transaction()
+    for i in range(6):
+        tx0.add_vertex("person", name=f"p{i}")
+    tx0.commit()
+    p = QueryProfiler()
+    tx = graph.new_transaction()
+    from titan_tpu.query.graphquery import GraphQuery
+    res = GraphQuery(tx).with_profiler(p).has("name", "p3").vertices()
+    assert len(res) == 1
+    names = [c.name for c in p.children]
+    assert "backend-query" in names
+    bq = p.children[names.index("backend-query")]
+    assert bq.annotations["results"] == 1
+    opt = p.children[names.index("optimization")]
+    assert opt.annotations["indexed"] is True
+    tx.commit()
+
+
+def test_traversal_profile_steps(graph):
+    m = graph.traversal().V().out("knows").out("knows").count().profile()
+    step_names = [s.name for s in m.steps]
+    assert step_names[-1] == "count"
+    assert step_names.count("vstep") == 2
+    # 6 vertices -> 4 two-hop results -> count folds to 1 traverser
+    assert m.steps[-1].count == 1
+    assert m.total_ns > 0
+    # own times sum to <= total
+    assert sum(s.own_ns for s in m.steps) <= m.total_ns * 1.5
+    text = m.render()
+    assert "TOTAL" in text and "count" in text
+
+
+def test_traversal_profile_compiled(graph):
+    src = graph.traversal().with_computer("tpu")
+    m = src.V().out("knows").count().profile()
+    assert m.compiled
+    assert m.steps[0].name == "olap(compiled)"
+    assert "compiled OLAP" in m.render()
+
+
+def test_profile_matches_unprofiled_result(graph):
+    plain = graph.traversal().V().out("knows").count().next()
+    m = graph.traversal().V().out("knows").count().profile()
+    # profiling must not change semantics: the count step saw the same value
+    assert plain == 5
+    assert m.steps[-1].count == 1

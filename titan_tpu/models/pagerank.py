@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from titan_tpu.olap.api import DenseProgram
+from titan_tpu.olap.api import DenseMapReduce, DenseProgram
 
 
 class PageRank(DenseProgram):
@@ -46,6 +46,28 @@ class PageRank(DenseProgram):
 
     def outputs(self, state, params):
         return {"rank": state["rank"]}
+
+
+class TopRanksMapReduce(DenseMapReduce):
+    """Post-BSP aggregation fixture (reference: titan-test
+    olap/PageRankMapReduce companion): top-k (vertex id, rank) pairs,
+    computed as one device-side top_k instead of per-vertex map/reduce."""
+
+    memory_key = "pageRank"
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    def compute(self, state, snapshot, params):
+        import jax
+        ranks = jnp.asarray(state["rank"])
+        k = min(self.k, ranks.shape[0])
+        vals, idx = jax.lax.top_k(ranks, k)
+        import numpy as np
+        idx = np.asarray(idx)
+        vals = np.asarray(vals)
+        vids = np.asarray(snapshot.vertex_ids)[idx]
+        return [(int(v), float(r)) for v, r in zip(vids, vals)]
 
 
 def run(computer, alpha: float = 0.85, iterations: int = 20, tol: float = 0.0,

@@ -8,9 +8,21 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from titan_tpu.olap.api import DenseProgram
+from titan_tpu.olap.api import DenseMapReduce, DenseProgram
 
 FINF = jnp.float32(3.0e38)
+
+
+class MaxDistanceMapReduce(DenseMapReduce):
+    """(reference: titan-test olap/ShortestDistanceMapReduce companion)
+    maximum finite distance reached from the source."""
+
+    memory_key = "shortestDistance.max"
+
+    def compute(self, state, snapshot, params):
+        d = jnp.asarray(state["dist"])
+        finite = d < FINF
+        return float(jnp.where(finite, d, -jnp.inf).max())
 
 
 class SSSP(DenseProgram):

@@ -38,13 +38,21 @@ def _build() -> bool:
     # new one, never a half-written library. The build recipe lives in the
     # Makefile (single source of truth); SO= overrides the output name.
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["make", "-s", "-C", _DIR, f"SO={os.path.basename(tmp)}"]
+    make_cmd = ["make", "-s", "-C", _DIR, f"SO={os.path.basename(tmp)}"]
+    # direct-g++ fallback for make-less hosts; flags mirror the Makefile's
+    # defaults
+    cxx_cmd = [os.environ.get("CXX", "g++"), "-O3", "-std=c++17", "-fPIC",
+               "-Wall", "-Wextra", "-shared", "-o", tmp, _SRC]
     try:
-        proc = subprocess.run(cmd, capture_output=True, timeout=120)
-        if proc.returncode != 0 or not os.path.exists(tmp):
-            return False
-        os.replace(tmp, _SO)
-        return True
+        for cmd in (make_cmd, cxx_cmd):
+            try:
+                proc = subprocess.run(cmd, capture_output=True, timeout=120)
+            except FileNotFoundError:
+                continue
+            if proc.returncode == 0 and os.path.exists(tmp):
+                os.replace(tmp, _SO)
+                return True
+        return False
     except (OSError, subprocess.TimeoutExpired):
         return False
     finally:
@@ -65,7 +73,17 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(_SO)
-        return _bind(lib)
+        bound = _bind(lib)
+    except (OSError, AttributeError):
+        bound = None
+    if bound is not None:
+        return bound
+    # a library that loads but fails binding (ABI drift, e.g. a prebuilt
+    # artifact newer than the source) is worth one rebuild attempt
+    if not _build():
+        return None
+    try:
+        return _bind(ctypes.CDLL(_SO))
     except (OSError, AttributeError):
         return None
 

@@ -13,7 +13,28 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
-from titan_tpu.olap.api import Memory, Messenger, ScanMetrics, VertexProgram
+from titan_tpu.olap.api import (MapReduce, Memory, Messenger, ScanMetrics,
+                                VertexProgram, execute_map_reduce)
+
+
+def _check_map_reduces(map_reduces, require=None) -> None:
+    """Reject wrong stage types up front and duplicate memory keys (two
+    stages sharing a key would silently overwrite each other's result)."""
+    if not map_reduces:
+        return
+    seen = set()
+    for mr in map_reduces:
+        if require is not None and not isinstance(mr, require):
+            names = ([r.__name__ for r in require]
+                     if isinstance(require, tuple) else [require.__name__])
+            raise TypeError(
+                f"{type(mr).__name__} is not a supported MapReduce stage "
+                f"here (need {'/'.join(names)}; DenseMapReduce runs on the "
+                "TPU computer only)")
+        if mr.memory_key in seen:
+            raise ValueError(
+                f"duplicate MapReduce memory_key {mr.memory_key!r}")
+        seen.add(mr.memory_key)
 
 
 class VertexMemory:
@@ -121,7 +142,8 @@ class HostGraphComputer:
         self.num_threads = num_threads or min(32, (os.cpu_count() or 4))
 
     def run(self, program: VertexProgram, max_iterations: int = 100,
-            write_back: bool = False) -> HostComputerResult:
+            write_back: bool = False,
+            map_reduces: Optional[list] = None) -> HostComputerResult:
         memory = Memory()
         vm = VertexMemory(program.combiner())
         program.setup(memory)
@@ -141,6 +163,16 @@ class HostGraphComputer:
             iterations += 1
             if program.terminate(memory) or iterations >= max_iterations:
                 break
+        # MapReduce stages over the final vertex states (reference:
+        # FulgoraGraphComputer.java:192-246)
+        _check_map_reduces(map_reduces, require=MapReduce)
+        for mr in (map_reduces or ()):
+            tx = self.graph.new_transaction(read_only=True)
+            try:
+                memory.set(mr.memory_key, execute_map_reduce(
+                    mr, (ComputerVertex(v, vm) for v in tx.vertices())))
+            finally:
+                tx.rollback()
         if write_back and program.state_keys:
             self._write_back(program, vm)
         return HostComputerResult(memory, vm.all_states(), iterations)

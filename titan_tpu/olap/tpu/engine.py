@@ -34,12 +34,40 @@ from titan_tpu.parallel.partition import ShardedCSR, shard_csr
 
 
 class TPUEngineResult(dict):
-    """Final per-vertex arrays + run metadata."""
+    """Final per-vertex arrays + run metadata (+ MapReduce results in
+    ``memory``, mirroring the host computer's Memory)."""
 
     def __init__(self, outputs: dict, iterations: int, n: int):
         super().__init__(outputs)
         self.iterations = iterations
         self.n = n
+        self.memory: dict = {}
+
+
+class _DenseVertexView:
+    """Minimal vertex view over dense output arrays for classic MapReduce
+    stages run against a TPU result (state reads only; adjacency would need
+    the OLTP tx and is out of scope for post-BSP aggregation)."""
+
+    __slots__ = ("_snap", "_state", "_di")
+
+    def __init__(self, snap, state: dict, di: int):
+        self._snap = snap
+        self._state = state
+        self._di = di
+
+    @property
+    def id(self) -> int:
+        return int(self._snap.vertex_ids[self._di])
+
+    def get_state(self, key: str, default=None):
+        arr = self._state.get(key)
+        if arr is None:
+            return default
+        return arr[self._di].item() if arr.ndim == 1 else arr[self._di]
+
+    def value(self, key: str, default=None):
+        return self.get_state(key, default)
 
 
 def _pad_state(state: dict, n: int, n_pad: int) -> dict:
@@ -83,14 +111,41 @@ class TPUGraphComputer:
         return snap
 
     def run(self, program: DenseProgram, params: Optional[dict] = None,
-            snapshot: Optional[GraphSnapshot] = None) -> TPUEngineResult:
+            snapshot: Optional[GraphSnapshot] = None,
+            map_reduces: Optional[list] = None) -> TPUEngineResult:
         snap = snapshot or self.snapshot(edge_keys=program.edge_keys())
         ndev = self.num_devices
         if ndev <= 0:
             ndev = len(jax.devices())
         if ndev == 1:
-            return run_single(program, snap, params)
-        return run_sharded(program, snap, params, vertex_mesh(ndev))
+            result = run_single(program, snap, params)
+        else:
+            result = run_sharded(program, snap, params, vertex_mesh(ndev))
+        if map_reduces:
+            self._run_map_reduces(map_reduces, result, snap, params or {})
+        return result
+
+    def _run_map_reduces(self, map_reduces, result: "TPUEngineResult",
+                         snap: GraphSnapshot, params: dict) -> None:
+        """Post-BSP MapReduce stages (reference:
+        FulgoraGraphComputer.java:192-246). DenseMapReduce runs as one array
+        program over the output arrays; classic MapReduce iterates host-side
+        vertex views over the dense state."""
+        from titan_tpu.olap.api import (DenseMapReduce, MapReduce,
+                                        execute_map_reduce)
+        from titan_tpu.olap.computer import _check_map_reduces
+        _check_map_reduces(map_reduces, require=(DenseMapReduce, MapReduce))
+        host_state = None
+        for mr in map_reduces:
+            if isinstance(mr, DenseMapReduce):
+                result.memory[mr.memory_key] = mr.compute(dict(result), snap,
+                                                          params)
+                continue
+            if host_state is None:
+                host_state = {k: np.asarray(v) for k, v in result.items()}
+            views = (_DenseVertexView(snap, host_state, di)
+                     for di in range(snap.n))
+            result.memory[mr.memory_key] = execute_map_reduce(mr, views)
 
 
 # ---------------------------------------------------------------------------

@@ -33,6 +33,8 @@ class GraphQuery:
         self._label: Optional[str] = None
         self._orders: list[tuple[str, str]] = []
         self._limit: Optional[int] = None
+        from titan_tpu.query.profile import NO_OP
+        self._profiler = NO_OP
 
     # -- builder -------------------------------------------------------------
 
@@ -65,6 +67,12 @@ class GraphQuery:
         self._limit = n
         return self
 
+    def with_profiler(self, profiler) -> "GraphQuery":
+        """Thread a QueryProfiler through execution (reference: profiler
+        threading at StandardTitanTx.java:1030,1116,1247)."""
+        self._profiler = profiler
+        return self
+
     # -- execution -----------------------------------------------------------
 
     def vertices(self) -> list:
@@ -77,36 +85,45 @@ class GraphQuery:
         return len(self.vertices())
 
     def _execute(self, element: str) -> list:
+        from titan_tpu.query import profile as prof
         tx = self.tx
-        ids = self._index_retrieval(element)
+        with self._profiler.group(prof.OPTIMIZATION) as p:
+            p.annotate("conditions", len(self._conditions))
+            ids = self._index_retrieval(element)
+            p.annotate("indexed", ids is not None)
         if ids is None:
-            out = list(self._full_scan(element))
+            with self._profiler.group(prof.FULL_SCAN) as p:
+                out = list(self._full_scan(element))
+                p.annotate("results", len(out))
         else:
-            out = []
-            seen = set()
-            # mixed-edge hits carry only a relation id; resolve them all in
-            # ONE edge-store pass instead of one scan per hit
-            rel_ids = {h[1] for h in ids
-                       if isinstance(h, tuple) and len(h) == 2
-                       and h[0] == "rel"}
-            rel_map = self._edges_by_rel_ids(rel_ids) if rel_ids else {}
-            for eid in ids:
-                if element == "vertex":
-                    el = tx.vertex(eid)
-                elif isinstance(eid, tuple) and len(eid) == 2 \
-                        and eid[0] == "rel":
-                    el = rel_map.get(eid[1])
-                else:
-                    el = self._edge_from_hit(eid)
-                if el is None or el.id in seen:
-                    continue
-                seen.add(el.id)
-                if self._matches(el):
-                    out.append(el)
-            # the index can't see this tx's uncommitted elements — merge the
-            # tx delta the way edgeProcessor merges adjacency deltas
-            out.extend(el for el in self._tx_delta(element)
-                       if el.id not in seen and self._matches(el))
+            with self._profiler.group(prof.BACKEND_QUERY) as p:
+                p.annotate("hits", len(ids))
+                out = []
+                seen = set()
+                # mixed-edge hits carry only a relation id; resolve them all
+                # in ONE edge-store pass instead of one scan per hit
+                rel_ids = {h[1] for h in ids
+                           if isinstance(h, tuple) and len(h) == 2
+                           and h[0] == "rel"}
+                rel_map = self._edges_by_rel_ids(rel_ids) if rel_ids else {}
+                for eid in ids:
+                    if element == "vertex":
+                        el = tx.vertex(eid)
+                    elif isinstance(eid, tuple) and len(eid) == 2 \
+                            and eid[0] == "rel":
+                        el = rel_map.get(eid[1])
+                    else:
+                        el = self._edge_from_hit(eid)
+                    if el is None or el.id in seen:
+                        continue
+                    seen.add(el.id)
+                    if self._matches(el):
+                        out.append(el)
+                # the index can't see this tx's uncommitted elements — merge
+                # the tx delta the way edgeProcessor merges adjacency deltas
+                out.extend(el for el in self._tx_delta(element)
+                           if el.id not in seen and self._matches(el))
+                p.annotate("results", len(out))
         for key, direction in reversed(self._orders):
             out.sort(key=lambda el: ((v := el.value(key)) is None, v),
                      reverse=(direction == "desc"))

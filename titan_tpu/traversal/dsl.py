@@ -275,7 +275,7 @@ class Traversal:
             return t.obj
         raise StopIteration
 
-    def _execute(self) -> Iterator[Traverser]:
+    def _execute(self, _stages: Optional[list] = None) -> Iterator[Traverser]:
         if self.source is None:
             raise ValueError(
                 "anonymous traversal can only be used as a sub-traversal")
@@ -284,15 +284,20 @@ class Traversal:
 
         # OLAP compilation: a supported V().has(...).out()...count() chain on
         # the tpu computer runs as CSR supersteps instead of interpretation
-        if self.source._computer == "tpu":
-            from titan_tpu.traversal.olap_compile import (FallbackToInterpreter,
-                                                          try_compile)
-            compiled = try_compile(steps, self.source)
-            if compiled is not None:
-                try:
-                    return compiled.run()
-                except FallbackToInterpreter:
-                    pass
+        if _stages is None:
+            results = self._run_compiled(steps)
+            if results is not None:
+                return results
+
+        def timed(name, it):
+            # .profile(): wrap each pipeline stage with a timing iterator
+            if _stages is None:
+                return it
+            from titan_tpu.query.profile import StepMetrics, TimedStage
+            stage = TimedStage(it, StepMetrics(name),
+                               _stages[-1] if _stages else None)
+            _stages.append(stage)
+            return stage
 
         traversers: Iterable[Traverser] = iter(())
         i = 0
@@ -301,20 +306,65 @@ class Traversal:
                 steps[1][0] == "Vfiltered":
             indexed = self._indexed_start(tx, steps[1][1][0])
             if indexed is not None:
-                traversers = indexed
+                traversers = timed("V(indexed)", indexed)
                 i = 2
         while i < len(steps):
             name, args = steps[i]
             # repeat(...).times(n) pairs up
             if name == "repeat" and i + 1 < len(steps) and steps[i + 1][0] == "times":
                 sub, n = args[0], steps[i + 1][1][0]
-                for _ in range(n):
-                    traversers = self._apply_sub(tx, traversers, sub)
+                for k in range(n):
+                    traversers = timed(f"repeat[{k}]",
+                                       self._apply_sub(tx, traversers, sub))
                 i += 2
                 continue
-            traversers = self._apply(tx, traversers, name, args)
+            traversers = timed(name, self._apply(tx, traversers, name, args))
             i += 1
         return iter(traversers)
+
+    def _run_compiled(self, steps) -> Optional[Iterator[Traverser]]:
+        """Try the TPU OLAP compiler on folded steps; None means interpret
+        (not on the tpu computer / unsupported pattern / runtime fallback)."""
+        if self.source is None or self.source._computer != "tpu":
+            return None
+        from titan_tpu.traversal.olap_compile import (FallbackToInterpreter,
+                                                      try_compile)
+        compiled = try_compile(steps, self.source)
+        if compiled is None:
+            return None
+        try:
+            return compiled.run()
+        except FallbackToInterpreter:
+            return None
+
+    def profile(self):
+        """Execute and return per-step TraversalMetrics (reference:
+        Gremlin ``.profile()`` via TP3ProfileWrapper)."""
+        import time as _time
+
+        from titan_tpu.query.profile import (StepMetrics, TimedStage,
+                                             TraversalMetrics)
+        if self.source is not None and self.source._computer == "tpu":
+            # compiled plans execute as one fused device program — report
+            # them as a single step rather than pretending per-step times
+            steps = self._fold_has_into_start(list(self._steps))
+            t0 = _time.perf_counter_ns()
+            results = self._run_compiled(steps)
+            if results is not None:
+                results = list(results)
+                total = _time.perf_counter_ns() - t0
+                sm = StepMetrics("olap(compiled)")
+                sm.count = len(results)
+                sm.time_ns = sm.own_ns = total
+                return TraversalMetrics([sm], total, compiled=True)
+        stages: list[TimedStage] = []
+        t0 = _time.perf_counter_ns()
+        for _ in self._execute(_stages=stages):
+            pass
+        total = _time.perf_counter_ns() - t0
+        for s in stages:
+            s.finalize()
+        return TraversalMetrics([s.metrics for s in stages], total)
 
     @staticmethod
     def _fold_has_into_start(steps: list) -> list:
