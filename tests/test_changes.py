@@ -1,0 +1,117 @@
+"""Trigger logs + LogProcessorFramework change streaming.
+
+Modeled on the reference's TitanBus user-log contract
+(docs/TitanBus.md:5-13) and LogProcessorFramework tests: transactions
+tagged with a log identifier stream their change set to ulog_<id>; registered
+processors receive a ChangeState per committed tx.
+"""
+
+import time
+
+import pytest
+
+import titan_tpu
+from titan_tpu.core.changes import ChangeState, change_payload
+
+
+@pytest.fixture
+def graph():
+    g = titan_tpu.open("inmemory")
+    yield g
+    g.close()
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_change_payload_contents(graph):
+    tx = graph.new_transaction()
+    a = tx.add_vertex("person", name="alice")
+    b = tx.add_vertex("person", name="bob")
+    a.add_edge("knows", b)
+    payload = change_payload(graph, tx, 42)
+    state = ChangeState(payload)
+    assert state.txid == 42
+    assert set(state.added_vertices()) == {a.id, b.id}
+    knows = state.added_edges("knows")
+    assert len(knows) == 1
+    assert knows[0]["out"] == a.id and knows[0]["in"] == b.id
+    names = {p["value"] for p in state.added_properties("name")}
+    assert names == {"alice", "bob"}
+    # system relations (vertex-exists, label edges) are filtered out
+    all_types = {r["type"] for r in state.added_relations()}
+    assert all_types == {"knows", "name"}
+    tx.rollback()
+
+
+def test_processor_receives_committed_changes(graph):
+    received = []
+    fw = titan_tpu.open_log_processors(graph)
+    fw.add_log_processor("stream") \
+        .set_start_time(0) \
+        .set_read_interval_ms(20) \
+        .add_processor(lambda g, txid, state: received.append(state)) \
+        .build()
+
+    tx = graph.new_transaction(log_identifier="stream")
+    v = tx.add_vertex("person", name="carol")
+    vid = v.id
+    tx.commit()
+
+    assert _wait_for(lambda: len(received) >= 1)
+    state = received[0]
+    assert vid in state.added_vertices()
+    assert state.added_properties("name")[0]["value"] == "carol"
+    assert state.timestamp > 0
+
+
+def test_untagged_tx_does_not_stream(graph):
+    received = []
+    fw = titan_tpu.open_log_processors(graph)
+    fw.add_log_processor("only-tagged") \
+        .set_start_time(0) \
+        .set_read_interval_ms(20) \
+        .add_processor(lambda g, txid, state: received.append(state)) \
+        .build()
+
+    tx = graph.new_transaction()          # no log identifier
+    tx.add_vertex("person", name="quiet")
+    tx.commit()
+    tx2 = graph.new_transaction(log_identifier="only-tagged")
+    tx2.add_vertex("person", name="loud")
+    tx2.commit()
+
+    assert _wait_for(lambda: len(received) >= 1)
+    time.sleep(0.1)
+    assert len(received) == 1
+    assert received[0].added_properties("name")[0]["value"] == "loud"
+
+
+def test_removal_changes_stream(graph):
+    tx = graph.new_transaction()
+    v = tx.add_vertex("person", name="temp")
+    vid = v.id
+    tx.commit()
+
+    received = []
+    fw = titan_tpu.open_log_processors(graph)
+    fw.add_log_processor("removals") \
+        .set_start_time(0) \
+        .set_read_interval_ms(20) \
+        .add_processor(lambda g, txid, state: received.append(state)) \
+        .build()
+
+    tx2 = graph.new_transaction(log_identifier="removals")
+    tx2.vertex(vid).remove()
+    tx2.commit()
+
+    assert _wait_for(lambda: len(received) >= 1)
+    state = received[0]
+    assert vid in state.removed_vertices()
+    assert any(r["type"] == "name" for r in state.removed_relations())

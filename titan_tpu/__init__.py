@@ -26,4 +26,11 @@ def open(config):  # noqa: A001  (deliberate builtin shadow, package-level)
     return open_graph(config)
 
 
-__all__ = ["open", "errors", "__version__"]
+def open_log_processors(graph):
+    """Change-stream framework over the graph's user trigger logs
+    (reference: TitanFactory.openTransactionLog → LogProcessorFramework)."""
+    from titan_tpu.core.changes import LogProcessorFramework
+    return LogProcessorFramework(graph)
+
+
+__all__ = ["open", "open_log_processors", "errors", "__version__"]

@@ -123,10 +123,13 @@ class StandardGraph:
 
     # -- transactions --------------------------------------------------------
 
-    def new_transaction(self, read_only: bool = False) -> GraphTransaction:
+    def new_transaction(self, read_only: bool = False,
+                        log_identifier: Optional[str] = None
+                        ) -> GraphTransaction:
         self._check_open()
         self.count_tx("begin")
-        return GraphTransaction(self, read_only=read_only)
+        return GraphTransaction(self, read_only=read_only,
+                                log_identifier=log_identifier)
 
     def count_tx(self, event: str) -> None:
         """tx begin/commit/rollback counters (reference: docs/monitoring.txt:7-12
@@ -339,6 +342,19 @@ class StandardGraph:
                 wal.log_primary_success(txid)
             try:
                 btx.commit_indexes()
+                # user trigger log between index commit and the SECONDARY
+                # WAL record (reference: StandardTitanGraph.commit:725-772)
+                if tx.log_identifier:
+                    from titan_tpu.core.changes import (USER_LOG_PREFIX,
+                                                        change_payload)
+                    ulog = self.backend.log_manager.open_log(
+                        USER_LOG_PREFIX + tx.log_identifier)
+                    # without a WAL there is no txid; a commit timestamp is
+                    # the next-best unique tag for the change stream
+                    tag = txid if txid is not None \
+                        else self.backend.times.time()
+                    ulog.add(self.serializer.value_bytes(
+                        change_payload(self, tx, tag)))
                 if wal is not None:
                     wal.log_secondary_success(txid)
             except BaseException:
@@ -407,17 +423,32 @@ class StandardGraph:
         finally:
             txh.commit()
 
+    def _route_row(self, row_vid: int, other_vid: int) -> int:
+        """Physical row for one endpoint of an edge. A vertex cut's edge
+        entry lands on the representative copy in the OTHER endpoint's
+        partition, so the two rows of an edge colocate (reference:
+        docs/partitioning.txt:33-47 — writes go to the copy colocated with
+        the other endpoint; system relations stay on the canonical copy)."""
+        if self.idm.is_partitioned_vertex(row_vid) and \
+                not self.idm.is_schema_id(other_vid):
+            return self.idm.partitioned_vertex_id(
+                self.idm.count(row_vid), self.idm.partition(other_vid))
+        return row_vid
+
     def _serialize(self, rel):
-        """Yield (vertex_id, Entry) per materialized endpoint row."""
+        """Yield (row_vertex_id, Entry) per materialized endpoint row.
+        Relation endpoints inside the entry are always CANONICAL ids; only
+        the row key is representative-routed."""
         if rel.is_property:
             yield rel.out_vertex_id, self.codec.write_property(
                 rel.type_id, rel.relation_id, rel.value, self.schema)
             return
         # edge: OUT row always; IN row unless unidirected or endpoint is a
         # schema vertex (vertex-label edges only materialize on the OUT side)
-        yield rel.out_vertex_id, self.codec.write_edge(
-            rel.type_id, rel.relation_id, Direction.OUT, rel.in_vertex_id,
-            self.schema, rel.properties)
+        yield self._route_row(rel.out_vertex_id, rel.in_vertex_id), \
+            self.codec.write_edge(
+                rel.type_id, rel.relation_id, Direction.OUT, rel.in_vertex_id,
+                self.schema, rel.properties)
         unidirected = False
         st = self.schema.get_type(rel.type_id) \
             if not self.schema.system.is_system(rel.type_id) else None
@@ -426,9 +457,10 @@ class StandardGraph:
         if self.idm.is_schema_id(rel.in_vertex_id):
             unidirected = True
         if not unidirected:
-            yield rel.in_vertex_id, self.codec.write_edge(
-                rel.type_id, rel.relation_id, Direction.IN, rel.out_vertex_id,
-                self.schema, rel.properties)
+            yield self._route_row(rel.in_vertex_id, rel.out_vertex_id), \
+                self.codec.write_edge(
+                    rel.type_id, rel.relation_id, Direction.IN,
+                    rel.out_vertex_id, self.schema, rel.properties)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -32,12 +32,16 @@ from titan_tpu.storage.api import Entry, KeySliceQuery, SliceQuery
 
 
 class GraphTransaction:
-    def __init__(self, graph, read_only: bool = False):
+    def __init__(self, graph, read_only: bool = False,
+                 log_identifier: Optional[str] = None):
         self.graph = graph
         self.schema = graph.schema
         self.codec = graph.codec
         self.idm = graph.idm
         self.read_only = read_only
+        # trigger log: changes of this tx stream to ulog_<identifier>
+        # (reference: docs/TitanBus.md:5-13, tx logIdentifier)
+        self.log_identifier = log_identifier
         self._backend_tx = None
         self._open = True
         self._lock = threading.RLock()
@@ -94,6 +98,32 @@ class GraphTransaction:
             self._slice_cache_size += len(entries) + 1
         return entries
 
+    def _multi_edge_query(self, keys, q) -> dict:
+        """Batched multi-row slice read through the tx slice cache: cached
+        rows answer locally, the rest go in ONE edge_store_multi_query."""
+        from titan_tpu.storage.api import apply_slice
+        result: dict[bytes, list] = {}
+        misses = []
+        for kb in keys:
+            hit = None
+            for cq, entries in self._slice_cache.get(kb, ()):
+                if cq.subsumes(q):
+                    hit = apply_slice(entries, q)
+                    break
+            if hit is None:
+                misses.append(kb)
+            else:
+                result[kb] = hit
+        if misses:
+            fetched = self.backend_tx.edge_store_multi_query(misses, q)
+            result.update(fetched)
+            for kb in misses:
+                if self._slice_cache_size < self._slice_cache_cap:
+                    entries = fetched.get(kb, [])
+                    self._slice_cache.setdefault(kb, []).append((q, entries))
+                    self._slice_cache_size += len(entries) + 1
+        return result
+
     def vertex_handle(self, vid: int) -> Vertex:
         v = self._vertices.get(vid)
         if v is None:
@@ -130,6 +160,13 @@ class GraphTransaction:
             if label_type is not None and label_type.partitioned:
                 idtype = IDType.PARTITIONED_VERTEX
             vid = self.graph.id_assigner.next_vertex_id(idtype=idtype)
+            if idtype is IDType.PARTITIONED_VERTEX:
+                # the user-visible id of a vertex-cut vertex is its CANONICAL
+                # representative; system relations and properties live on the
+                # canonical row, adjacency spreads over all representatives
+                # (reference: IDManager.getCanonicalVertexId, vertex state at
+                # the canonical copy)
+                vid = self.idm.canonical_vertex_id(vid)
         v = self.vertex_handle(vid)
         self._new_vertices.add(vid)
         # existence marker (reference: BaseKey.VertexExists)
@@ -261,8 +298,11 @@ class GraphTransaction:
     # ----------------------------------------------------------------- reads
 
     def vertex(self, vid: int) -> Optional[Vertex]:
-        """Vertex by id, or None if it doesn't exist."""
+        """Vertex by id, or None if it doesn't exist. A representative id of
+        a vertex cut resolves to its canonical vertex."""
         self._check_open()
+        if self.idm.is_partitioned_vertex(vid):
+            vid = self.idm.canonical_vertex_id(vid)
         if vid in self._removed_vertices:
             return None
         if vid in self._new_vertices:
@@ -412,14 +452,29 @@ class GraphTransaction:
 
     def _stored_relations(self, vid, direction, type_ids, category,
                           include_system) -> Iterator[InternalRelation]:
-        key = self.idm.key_bytes(vid)
+        # a vertex cut's adjacency is spread over ALL representative rows;
+        # properties/system relations live on the canonical row only
+        # (reference: OLTP reads fan out over getPartitionedVertexRepresentatives)
+        if self.idm.is_partitioned_vertex(vid) and \
+                category is not RelationCategory.PROPERTY:
+            keys = [self.idm.key_bytes(r)
+                    for r in self.idm.partitioned_vertex_representatives(vid)]
+        else:
+            keys = [self.idm.key_bytes(vid)]
         for q in self._slices_for(direction, type_ids, category, include_system):
-            for entry in self.edge_query(KeySliceQuery(vid_key := key, q)):
-                rc = self.codec.parse(entry, self.schema)
-                rel = self._relation_from_cache(vid, rc)
-                if self._matches(rel, vid, direction, type_ids, category,
-                                 include_system):
-                    yield rel
+            if len(keys) == 1:
+                per_key = {keys[0]: self.edge_query(KeySliceQuery(keys[0], q))}
+            else:
+                # vertex cut: ONE batched multi-row read over all
+                # representative rows instead of num_partitions point reads
+                per_key = self._multi_edge_query(keys, q)
+            for key in keys:
+                for entry in per_key.get(key, ()):
+                    rc = self.codec.parse(entry, self.schema)
+                    rel = self._relation_from_cache(vid, rc)
+                    if self._matches(rel, vid, direction, type_ids, category,
+                                     include_system):
+                        yield rel
 
     def _relation_from_cache(self, vid: int, rc) -> InternalRelation:
         if rc.category is RelationCategory.PROPERTY:
@@ -447,33 +502,20 @@ class GraphTransaction:
                 return {vid: [] for vid in vids}
         out: dict[int, list] = {vid: [] for vid in vids}
         stored_vids = [v for v in vids if v not in self._new_vertices]
-        keys = {self.idm.key_bytes(v): v for v in stored_vids}
+        keys: dict[bytes, int] = {}
+        for v in stored_vids:
+            if self.idm.is_partitioned_vertex(v):
+                # vertex cut: one batched read covers every representative row
+                for r in self.idm.partitioned_vertex_representatives(v):
+                    keys[self.idm.key_bytes(r)] = v
+            else:
+                keys[self.idm.key_bytes(v)] = v
         for q in self._slices_for(direction, type_ids, RelationCategory.EDGE,
                                   False):
             if not keys:
                 break
             # answer cached keys from the tx slice cache; batch only the rest
-            result = {}
-            misses = []
-            from titan_tpu.storage.api import apply_slice
-            for kb in keys:
-                hit = None
-                for cq, entries in self._slice_cache.get(kb, ()):
-                    if cq.subsumes(q):
-                        hit = apply_slice(entries, q)
-                        break
-                if hit is None:
-                    misses.append(kb)
-                else:
-                    result[kb] = hit
-            if misses:
-                fetched = self.backend_tx.edge_store_multi_query(misses, q)
-                result.update(fetched)
-                for kb in misses:
-                    if self._slice_cache_size < self._slice_cache_cap:
-                        entries = fetched.get(kb, [])
-                        self._slice_cache.setdefault(kb, []).append((q, entries))
-                        self._slice_cache_size += len(entries) + 1
+            result = self._multi_edge_query(list(keys), q)
             for kb, entries in result.items():
                 vid = keys[kb]
                 for entry in entries:

@@ -225,6 +225,21 @@ class IDManager:
         c = self.count(eid)
         return [self.partitioned_vertex_id(c, p) for p in range(self.num_partitions)]
 
+    def canonicalize_np(self, ids: np.ndarray) -> np.ndarray:
+        """Vectorized canonical_vertex_id: partitioned-vertex ids are mapped
+        to their canonical representative, everything else passes through
+        (the OLAP snapshot builder merges vertex-cut rows with this)."""
+        ids = np.asarray(ids, dtype=np.int64)
+        is_part = (ids & TYPE_MASK) == int(IDType.PARTITIONED_VERTEX)
+        if not is_part.any():
+            return ids
+        counts = ids >> (TYPE_BITS + self.partition_bits)
+        h = counts.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+        canon_p = ((h >> np.uint64(40)).astype(np.int64)) & self.partition_mask
+        canon = ((counts << (TYPE_BITS + self.partition_bits))
+                 | (canon_p << TYPE_BITS) | int(IDType.PARTITIONED_VERTEX))
+        return np.where(is_part, canon, ids)
+
     # -- vectorized unpacking (device/bulk paths) ---------------------------
 
     def partitions_np(self, ids: np.ndarray) -> np.ndarray:

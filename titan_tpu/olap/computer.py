@@ -144,6 +144,8 @@ class HostGraphComputer:
     def run(self, program: VertexProgram, max_iterations: int = 100,
             write_back: bool = False,
             map_reduces: Optional[list] = None) -> HostComputerResult:
+        # validate BEFORE the expensive BSP loop
+        _check_map_reduces(map_reduces, require=MapReduce)
         memory = Memory()
         vm = VertexMemory(program.combiner())
         program.setup(memory)
@@ -165,7 +167,6 @@ class HostGraphComputer:
                 break
         # MapReduce stages over the final vertex states (reference:
         # FulgoraGraphComputer.java:192-246)
-        _check_map_reduces(map_reduces, require=MapReduce)
         for mr in (map_reduces or ()):
             tx = self.graph.new_transaction(read_only=True)
             try:

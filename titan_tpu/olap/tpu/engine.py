@@ -113,6 +113,12 @@ class TPUGraphComputer:
     def run(self, program: DenseProgram, params: Optional[dict] = None,
             snapshot: Optional[GraphSnapshot] = None,
             map_reduces: Optional[list] = None) -> TPUEngineResult:
+        if map_reduces:
+            # validate BEFORE the expensive BSP run
+            from titan_tpu.olap.api import DenseMapReduce, MapReduce
+            from titan_tpu.olap.computer import _check_map_reduces
+            _check_map_reduces(map_reduces,
+                               require=(DenseMapReduce, MapReduce))
         snap = snapshot or self.snapshot(edge_keys=program.edge_keys())
         ndev = self.num_devices
         if ndev <= 0:
@@ -133,8 +139,6 @@ class TPUGraphComputer:
         vertex views over the dense state."""
         from titan_tpu.olap.api import (DenseMapReduce, MapReduce,
                                         execute_map_reduce)
-        from titan_tpu.olap.computer import _check_map_reduces
-        _check_map_reduces(map_reduces, require=(DenseMapReduce, MapReduce))
         host_state = None
         for mr in map_reduces:
             if isinstance(mr, DenseMapReduce):

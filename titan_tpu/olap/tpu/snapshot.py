@@ -105,6 +105,9 @@ def _scan_python(graph, rows, exists_q, scan_q, label_ids, key_ids):
         vid = idm.id_of_key_bytes(key)
         if not idm.is_user_vertex_id(vid):
             continue
+        # vertex-cut rows fold into the canonical vertex (reference:
+        # VertexProgramScanJob.java:76-92 canonical-representative aggregation)
+        vid = idm.canonical_vertex_id(vid)
         has_exist = False
         for e in entries:
             if exists_q.contains(e.column):
@@ -160,7 +163,10 @@ def _scan_native(graph, rows, exists_q, label_ids):
     kind, tcount, dpos = native.parse_heads(
         col_buf, np.asarray(offs, dtype=np.int64), exists_q.start)
     entry_row_a = np.asarray(entry_row, dtype=np.int64)
-    row_vids_a = np.asarray(row_vids, dtype=np.int64)
+    # vertex-cut rows fold into the canonical vertex (vectorized analog of
+    # the scan job's canonical-representative aggregation)
+    row_vids_a = graph.idm.canonicalize_np(
+        np.asarray(row_vids, dtype=np.int64))
 
     exists_rows = np.unique(entry_row_a[kind == native.KIND_EXISTS])
     vertex_id_list = row_vids_a[exists_rows].tolist()
