@@ -1,0 +1,126 @@
+"""Index-provider SPI contract, parameterized over both local providers.
+
+The reference's pattern: one shared suite (titan-test IndexProviderTest)
+instantiated per backend (Lucene/ES/Solr). Here: the in-memory provider and
+the sqlite-FTS5 provider (the Lucene-role embedded engine).
+"""
+
+import pytest
+
+from titan_tpu.indexing.ftsindex import FTSIndex
+from titan_tpu.indexing.memindex import MemoryIndex
+from titan_tpu.indexing.provider import (And, FieldCondition, IndexQuery,
+                                         KeyInformation, RawQuery)
+from titan_tpu.query.predicates import P
+
+
+@pytest.fixture(params=["mem", "fts", "fts-disk"])
+def provider(request, tmp_path):
+    if request.param == "mem":
+        p = MemoryIndex("t")
+    elif request.param == "fts":
+        p = FTSIndex("t")
+    else:
+        p = FTSIndex("t", str(tmp_path / "idx"))
+    yield p
+    p.close()
+
+
+def _doc(provider, store, docid, **fields):
+    tx = provider.begin_transaction()
+    for k, v in fields.items():
+        tx.add(store, docid, k, v)
+    tx.commit()
+
+
+def _fill(provider):
+    provider.register("s", "title", KeyInformation(str))
+    provider.register("s", "sku", KeyInformation(str, parameters=("STRING",)))
+    provider.register("s", "price", KeyInformation(float))
+    _doc(provider, "s", "d1", title="red fish blue fish", sku="A-1", price=3.5)
+    _doc(provider, "s", "d2", title="one fish two fish", sku="A-2", price=9.0)
+    _doc(provider, "s", "d3", title="green eggs and ham", sku="B-1", price=5.0)
+
+
+def test_text_contains(provider):
+    _fill(provider)
+    hits = provider.query("s", IndexQuery(
+        FieldCondition("title", P.text_contains("fish"))))
+    assert hits == ["d1", "d2"]
+    # multi-token AND semantics
+    hits = provider.query("s", IndexQuery(
+        FieldCondition("title", P.text_contains("blue fish"))))
+    assert hits == ["d1"]
+
+
+def test_conjunction_with_numeric_range(provider):
+    _fill(provider)
+    q = IndexQuery(And((FieldCondition("title", P.text_contains("fish")),
+                        FieldCondition("price", P.gt(4.0)))))
+    assert provider.query("s", q) == ["d2"]
+
+
+def test_string_mapped_exact(provider):
+    _fill(provider)
+    hits = provider.query("s", IndexQuery(
+        FieldCondition("sku", P.eq("B-1"))))
+    assert hits == ["d3"]
+
+
+def test_order_and_limit(provider):
+    _fill(provider)
+    q = IndexQuery(FieldCondition("price", P.gt(0.0)),
+                   orders=(("price", "desc"),), limit=2)
+    assert provider.query("s", q) == ["d2", "d3"]
+
+
+def test_field_deletion_and_doc_deletion(provider):
+    _fill(provider)
+    tx = provider.begin_transaction()
+    tx.delete("s", "d1", "title")
+    tx.commit()
+    hits = provider.query("s", IndexQuery(
+        FieldCondition("title", P.text_contains("fish"))))
+    assert hits == ["d2"]
+    tx2 = provider.begin_transaction()
+    tx2.delete_document("s", "d2")
+    tx2.commit()
+    hits = provider.query("s", IndexQuery(
+        FieldCondition("title", P.text_contains("fish"))))
+    assert hits == []
+
+
+def test_raw_query(provider):
+    _fill(provider)
+    hits = provider.raw_query("s", RawQuery("title:fish"))
+    assert {d for d, _ in hits} == {"d1", "d2"}
+    assert all(score > 0 for _, score in hits)
+    hits = provider.raw_query("s", RawQuery("fish eggs"))
+    assert hits == []                # AND across terms
+    hits = provider.raw_query("s", RawQuery("title:fish", limit=1))
+    assert len(hits) == 1
+
+
+def test_drop_store(provider):
+    _fill(provider)
+    provider.drop_store("s")
+    assert provider.query("s", IndexQuery(
+        FieldCondition("title", P.text_contains("fish")))) == []
+
+
+def test_fts_persistence_across_reopen(tmp_path):
+    d = str(tmp_path / "idx")
+    p = FTSIndex("t", d)
+    _fill(p)
+    p.close()
+    p2 = FTSIndex("t", d)
+    try:
+        hits = p2.query("s", IndexQuery(
+            FieldCondition("title", P.text_contains("fish"))))
+        assert hits == ["d1", "d2"]
+        # keyinfo (STRING mapping) survived too
+        assert p2.query("s", IndexQuery(
+            FieldCondition("sku", P.eq("A-2")))) == ["d2"]
+        assert p2.raw_query("s", RawQuery("eggs"))[0][0] == "d3"
+    finally:
+        p2.close()

@@ -1,0 +1,151 @@
+"""TTL: per-type cell expiry.
+
+Modeled on the reference's TTL tests in TitanGraphTest (titan-test;
+mgmt.setTTL on edge labels / property keys, vertex TTL on static labels)
+and the HBase storeTTL/cellTTL feature contract.
+"""
+
+import time
+
+import pytest
+
+import titan_tpu
+from titan_tpu.errors import TitanError
+from titan_tpu.storage.api import (Entry, KeySliceQuery, SliceQuery, TTLEntry,
+                                   entry_ttl)
+from titan_tpu.storage.inmemory import InMemoryStoreManager
+
+
+@pytest.fixture(params=["inmemory", "sqlite"])
+def graph(request, tmp_path):
+    if request.param == "inmemory":
+        g = titan_tpu.open("inmemory")
+    else:
+        g = titan_tpu.open({"storage.backend": "sqlite",
+                            "storage.directory": str(tmp_path / "db")})
+    yield g
+    g.close()
+
+
+def test_entry_ttl_helper():
+    assert entry_ttl(Entry(b"c", b"v")) == 0.0
+    assert entry_ttl(TTLEntry(b"c", b"v", 5.0)) == 5.0
+
+
+def test_store_level_cell_ttl():
+    mgr = InMemoryStoreManager()
+    assert mgr.features.cell_ttl
+    store = mgr.open_database("s")
+    txh = mgr.begin_transaction()
+    store.mutate(b"k", [TTLEntry(b"a", b"1", 0.05), Entry(b"b", b"2")], [], txh)
+    res = store.get_slice(KeySliceQuery(b"k", SliceQuery()), txh)
+    assert [e.column for e in res] == [b"a", b"b"]
+    time.sleep(0.07)
+    res = store.get_slice(KeySliceQuery(b"k", SliceQuery()), txh)
+    assert [e.column for e in res] == [b"b"]
+
+
+def test_edge_label_ttl(graph):
+    mgmt = graph.management()
+    label = mgmt.make_edge_label("session")
+    mgmt.set_ttl(label, 0.2)
+    assert mgmt.get_ttl("session") == pytest.approx(0.2)
+    mgmt.commit()
+
+    tx = graph.new_transaction()
+    a = tx.add_vertex("person", name="a")
+    b = tx.add_vertex("person", name="b")
+    a.add_edge("session", b)
+    a.add_edge("knows", b)   # no TTL
+    aid = a.id
+    tx.commit()
+
+    tx2 = graph.new_transaction()
+    assert len(list(tx2.vertex(aid).out_edges("session"))) == 1
+    tx2.rollback()
+
+    time.sleep(0.25)
+    tx3 = graph.new_transaction()
+    assert len(list(tx3.vertex(aid).out_edges("session"))) == 0
+    assert len(list(tx3.vertex(aid).out_edges("knows"))) == 1
+    tx3.rollback()
+
+
+def test_property_key_ttl(graph):
+    mgmt = graph.management()
+    key = mgmt.make_property_key("otp", str)
+    mgmt.set_ttl(key, 0.2)
+    mgmt.commit()
+
+    tx = graph.new_transaction()
+    v = tx.add_vertex("person", name="carol", otp="123456")
+    vid = v.id
+    tx.commit()
+
+    tx2 = graph.new_transaction()
+    assert tx2.vertex(vid).value("otp") == "123456"
+    tx2.rollback()
+    time.sleep(0.25)
+    tx3 = graph.new_transaction()
+    assert tx3.vertex(vid).value("otp") is None
+    assert tx3.vertex(vid).value("name") == "carol"   # untouched
+    tx3.rollback()
+
+
+def test_vertex_ttl_requires_static_label(graph):
+    mgmt = graph.management()
+    lbl = mgmt.make_vertex_label("ephemeral")   # NOT static
+    with pytest.raises(TitanError):
+        mgmt.set_ttl(lbl, 1.0)
+
+
+def test_static_vertex_label_ttl(graph):
+    mgmt = graph.management()
+    lbl = mgmt.make_vertex_label("flash", static=True)
+    mgmt.set_ttl(lbl, 0.2)
+    mgmt.commit()
+
+    tx = graph.new_transaction()
+    v = tx.add_vertex("flash", note="gone soon")
+    vid = v.id
+    tx.commit()
+
+    tx2 = graph.new_transaction()
+    assert tx2.vertex(vid) is not None
+    tx2.rollback()
+    time.sleep(0.25)
+    tx3 = graph.new_transaction()
+    assert tx3.vertex(vid) is None   # whole vertex expired
+    tx3.rollback()
+
+
+def test_expired_vertex_frees_unique_index(graph):
+    """Composite index entries expire WITH their element: a unique name can
+    be reused after the TTL'd vertex is gone (no permanent ghost row)."""
+    mgmt = graph.management()
+    lbl = mgmt.make_vertex_label("token", static=True)
+    mgmt.set_ttl(lbl, 0.2)
+    key = mgmt.make_property_key("code", str)
+    mgmt.build_index("byCode", "vertex").add_key(key).unique() \
+        .build_composite_index()
+    mgmt.commit()
+
+    tx = graph.new_transaction()
+    tx.add_vertex("token", code="X1")
+    tx.commit()
+    time.sleep(0.25)
+    tx2 = graph.new_transaction()
+    v2 = tx2.add_vertex("token", code="X1")   # reuse after expiry
+    tx2.commit()
+    tx3 = graph.new_transaction()
+    hits = tx3.query().has("code", "X1").vertices()
+    assert [v.id for v in hits] == [v2.id]
+    tx3.rollback()
+
+
+def test_ttl_survives_wal_payload_roundtrip(graph):
+    """TTLEntry rows in a WAL payload replay as plain entries."""
+    from titan_tpu.storage.api import TTLEntry
+    adds = [tuple(TTLEntry(b"c", b"v", 3.0)), tuple(Entry(b"d", b"w"))]
+    assert [Entry(a[0], a[1]) for a in adds] == [Entry(b"c", b"v"),
+                                                Entry(b"d", b"w")]

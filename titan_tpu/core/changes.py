@@ -146,11 +146,6 @@ class LogProcessorFramework:
             overrides["read_interval_ms"] = read_interval_ms
         log = self.graph.backend.log_manager.open_log(
             USER_LOG_PREFIX + identifier, **overrides)
-        if read_interval_ms is not None:
-            # the log manager caches per name and applies overrides only on
-            # first open (e.g. the commit path may have opened this ulog
-            # already) — apply the interval to the live instance as well
-            log._read_interval = read_interval_ms / 1000.0
         ser = self.graph.serializer
 
         def on_message(msg: LogMessage) -> None:

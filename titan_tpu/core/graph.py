@@ -97,9 +97,13 @@ class StandardGraph:
         from titan_tpu.config import defaults as d
         backend = self.config.get(d.INDEX_BACKEND, name)
         directory = self.config.get(d.INDEX_DIRECTORY, name)
-        if backend in ("memindex", "lucene", "elasticsearch", "solr"):
-            # every local shorthand maps to the in-process provider; real
-            # cluster providers plug in via import path
+        if backend in ("lucene", "fts"):
+            # embedded persistent full-text engine (the Lucene-role provider)
+            from titan_tpu.indexing.ftsindex import FTSIndex
+            provider = FTSIndex(name, directory or None)
+        elif backend in ("memindex", "elasticsearch", "solr"):
+            # in-process provider; real cluster providers plug in via
+            # import path
             from titan_tpu.indexing.memindex import MemoryIndex
             provider = MemoryIndex(name, directory or None)
         else:
@@ -229,6 +233,28 @@ class StandardGraph:
         # (vertex row, column) -> expected old value, for LOCK-consistency
         lock_targets: dict[tuple, Optional[bytes]] = {}
 
+        # vertex-label TTLs: every cell of a TTL'd STATIC-label vertex
+        # expires together (reference: prepareCommit TTL metadata,
+        # StandardTitanGraph.java:558-592; vertex TTL requires static labels)
+        label_ttl: dict[int, float] = {}
+        for vid, lid in tx._vertex_labels.items():
+            if lid:
+                st = self.schema.get_type(lid)
+                t = getattr(st, "ttl", 0.0) if st is not None else 0.0
+                if t > 0:
+                    label_ttl[vid] = t
+
+        def entry_with_ttl(rel, entry: Entry, row_vid: int) -> Entry:
+            from titan_tpu.storage.api import TTLEntry
+            ttls = [self.schema.ttl_of(rel.type_id)]
+            ttls.append(label_ttl.get(rel.out_vertex_id, 0.0))
+            if rel.is_edge:
+                ttls.append(label_ttl.get(rel.in_vertex_id, 0.0))
+            live = [t for t in ttls if t > 0]
+            if not live:
+                return entry
+            return TTLEntry(entry.column, entry.value, min(live))
+
         def add(vid: int, entry: Entry):
             additions.setdefault(self.idm.key_bytes(vid), []).append(entry)
 
@@ -248,7 +274,7 @@ class StandardGraph:
         for rel in tx._added.values():
             locked = self._needs_lock(rel)
             for vid, entry in self._serialize(rel):
-                add(vid, entry)
+                add(vid, entry_with_ttl(rel, entry, vid))
                 if locked:
                     lock_targets.setdefault(
                         (self.idm.key_bytes(vid), entry.column), None)

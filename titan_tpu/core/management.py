@@ -11,6 +11,7 @@ from typing import Optional
 
 from titan_tpu.core.defs import Cardinality, Multiplicity
 from titan_tpu.core.schema import EdgeLabel, PropertyKey, VertexLabel
+from titan_tpu.errors import TitanError
 
 
 class ManagementSystem:
@@ -43,6 +44,38 @@ class ManagementSystem:
     def make_vertex_label(self, name: str, partitioned: bool = False,
                           static: bool = False) -> VertexLabel:
         return self.schema.make_vertex_label(name, partitioned, static)
+
+    # -- TTL (reference: TitanManagement.setTTL/getTTL — per-type cell TTL
+    # honored by stores with features.cell_ttl) ------------------------------
+
+    def set_ttl(self, schema_type, ttl_seconds: float):
+        """TTL for relations of an edge label / property key, or for whole
+        vertices of a STATIC vertex label (the reference's constraint:
+        vertex TTL requires a static label, since later modifications would
+        outlive the original cells)."""
+        import dataclasses
+
+        from titan_tpu.core.schema import (EdgeLabel, PropertyKey,
+                                           SchemaType, VertexLabel)
+        st = schema_type if isinstance(schema_type, SchemaType) \
+            else self.schema.get_by_name(schema_type)
+        if st is None or not isinstance(st, (EdgeLabel, PropertyKey,
+                                             VertexLabel)):
+            raise TitanError(f"cannot set TTL on {schema_type!r}")
+        if isinstance(st, VertexLabel) and not st.static and ttl_seconds > 0:
+            raise TitanError(
+                f"vertex label {st.name!r} must be static to carry a TTL")
+        if not self.graph.backend.features.cell_ttl and ttl_seconds > 0:
+            raise TitanError(
+                "storage backend does not support cell TTL")
+        return self.schema.update_type(
+            dataclasses.replace(st, ttl=float(ttl_seconds)))
+
+    def get_ttl(self, schema_type) -> float:
+        from titan_tpu.core.schema import SchemaType
+        st = schema_type if isinstance(schema_type, SchemaType) \
+            else self.schema.get_by_name(schema_type)
+        return getattr(st, "ttl", 0.0) if st is not None else 0.0
 
     # -- inspection ----------------------------------------------------------
 

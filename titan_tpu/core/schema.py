@@ -75,11 +75,13 @@ class PropertyKey(SchemaType):
     cardinality: Cardinality = Cardinality.SINGLE
     status: SchemaStatus = SchemaStatus.ENABLED
     consistency: str = "none"   # none | lock (reference: ConsistencyModifier)
+    ttl: float = 0.0            # seconds; 0 = never (reference: mgmt.setTTL)
 
     def definition(self) -> dict:
         return {"kind": "key", "dtype": _DTYPE_NAMES[self.dtype],
                 "cardinality": self.cardinality.value,
-                "status": self.status.value, "consistency": self.consistency}
+                "status": self.status.value, "consistency": self.consistency,
+                "ttl": self.ttl}
 
 
 @dataclass(frozen=True)
@@ -89,22 +91,25 @@ class EdgeLabel(SchemaType):
     sort_key: tuple = ()
     status: SchemaStatus = SchemaStatus.ENABLED
     consistency: str = "none"
+    ttl: float = 0.0            # seconds; 0 = never (reference: mgmt.setTTL)
 
     def definition(self) -> dict:
         return {"kind": "label", "multiplicity": self.multiplicity.value,
                 "unidirected": self.unidirected,
                 "sort_key": list(self.sort_key), "status": self.status.value,
-                "consistency": self.consistency}
+                "consistency": self.consistency, "ttl": self.ttl}
 
 
 @dataclass(frozen=True)
 class VertexLabel(SchemaType):
     partitioned: bool = False
     static: bool = False
+    ttl: float = 0.0   # only meaningful for static labels (reference:
+                       # vertex TTL requires a static vertex label)
 
     def definition(self) -> dict:
         return {"kind": "vertexlabel", "partitioned": self.partitioned,
-                "static": self.static}
+                "static": self.static, "ttl": self.ttl}
 
 
 @dataclass(frozen=True)
@@ -149,16 +154,18 @@ def _from_definition(schema_id: int, name: str, d: dict) -> SchemaType:
         return PropertyKey(schema_id, name, _DTYPES[d["dtype"]],
                            Cardinality(d["cardinality"]),
                            SchemaStatus(d.get("status", "enabled")),
-                           d.get("consistency", "none"))
+                           d.get("consistency", "none"),
+                           d.get("ttl", 0.0))
     if kind == "label":
         return EdgeLabel(schema_id, name, Multiplicity(d["multiplicity"]),
                          d.get("unidirected", False),
                          tuple(d.get("sort_key", ())),
                          SchemaStatus(d.get("status", "enabled")),
-                         d.get("consistency", "none"))
+                         d.get("consistency", "none"),
+                         d.get("ttl", 0.0))
     if kind == "vertexlabel":
         return VertexLabel(schema_id, name, d.get("partitioned", False),
-                           d.get("static", False))
+                           d.get("static", False), d.get("ttl", 0.0))
     if kind == "index":
         return IndexDefinition(schema_id, name, d["element"], d["composite"],
                                tuple(d["key_ids"]), tuple(d["key_params"]),
@@ -316,6 +323,13 @@ class SchemaManager:
     def update_type(self, st: SchemaType) -> SchemaType:
         """Rewrite a type's definition (index lifecycle transitions etc.)."""
         return self._store_type(st, expect_new=False)
+
+    def ttl_of(self, type_id: int) -> float:
+        """Cell TTL (seconds) for relations of this type; 0 = never."""
+        if self.system.is_system(type_id):
+            return 0.0
+        st = self.get_type(type_id)
+        return getattr(st, "ttl", 0.0) if st is not None else 0.0
 
     # -- graph indexes -------------------------------------------------------
 

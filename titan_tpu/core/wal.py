@@ -153,7 +153,8 @@ class TransactionRecovery:
                     continue
                 store = backend.manager.open_database(store_name)
                 for key, (adds, dels) in by_key.items():
-                    store.mutate(key, [Entry(c, v) for c, v in adds],
+                    # adds may carry a third TTL element (TTLEntry rows)
+                    store.mutate(key, [Entry(a[0], a[1]) for a in adds],
                                  list(dels), txh)
             txh.commit()
             self.recovered += 1

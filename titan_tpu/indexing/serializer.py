@@ -104,6 +104,30 @@ class IndexSerializer:
 
     # -- update collection (the getIndexUpdates equivalent) ------------------
 
+    def _label_ttl(self, tx, vid: int) -> float:
+        lid = tx._vertex_labels.get(vid) or 0
+        if not lid:
+            return 0.0
+        st = self.schema.get_type(lid)
+        return getattr(st, "ttl", 0.0) if st else 0.0
+
+    def _composite_entry(self, tx, column: bytes, ix, vid=None, rel=None):
+        """Composite index entry, TTL'd to match its element so expired
+        elements don't leave permanent ghost rows (reference: prepareCommit
+        attaches the element TTL to index-store entries too)."""
+        ttls = [self.schema.ttl_of(kid) for kid in ix.key_ids]
+        if vid is not None:
+            ttls.append(self._label_ttl(tx, vid))
+        if rel is not None:
+            ttls.append(self.schema.ttl_of(rel.type_id))
+            ttls.append(self._label_ttl(tx, rel.out_vertex_id))
+            ttls.append(self._label_ttl(tx, rel.in_vertex_id))
+        live = [t for t in ttls if t > 0]
+        if not live:
+            return Entry(column, b"")
+        from titan_tpu.storage.api import TTLEntry
+        return TTLEntry(column, b"", min(live))
+
     def collect_updates(self, tx) -> list[IndexUpdate]:
         """Index updates implied by a transaction's added/deleted relations."""
         updates: list[IndexUpdate] = []
@@ -160,7 +184,8 @@ class IndexSerializer:
                         updates.append(IndexUpdate(
                             ix, True,
                             key=self.composite_row_key(ix, vals),
-                            entry=Entry(col, b"")))
+                            entry=self._composite_entry(tx, col, ix,
+                                                        vid=vid)))
                 else:
                     docid = self.docid_for(vid)
                     for kid in keys & set(ix.key_ids):
@@ -204,10 +229,14 @@ class IndexSerializer:
                     vals.append(rel.properties[kid])
                 else:
                     if ix.composite:
+                        col_e = self.edge_column(rel)
+                        entry = self._composite_entry(tx, col_e, ix,
+                                                      rel=rel) \
+                            if addition else Entry(col_e, b"")
                         updates.append(IndexUpdate(
                             ix, addition,
                             key=self.composite_row_key(ix, vals),
-                            entry=Entry(self.edge_column(rel), b"")))
+                            entry=entry))
                     else:
                         docid = self.docid_for(rel.relation_id)
                         for kid, value in zip(ix.key_ids, vals):

@@ -80,10 +80,14 @@ class P:
     # -- text (Text) ---------------------------------------------------------
 
     @staticmethod
-    def text_contains(token: str):
-        t = token.lower()
-        return P("textContains", token,
-                 lambda c: t in re.split(r"\W+", str(c).lower()))
+    def text_contains(query: str):
+        # reference Text.CONTAINS: the value must contain ALL terms of the
+        # (tokenized) query; a token-less query matches nothing
+        toks = [t for t in re.split(r"\W+", query.lower()) if t]
+        return P("textContains", query,
+                 lambda c: bool(toks)
+                 and all(t in set(re.split(r"\W+", str(c).lower()))
+                         for t in toks))
 
     @staticmethod
     def text_prefix(prefix: str):

@@ -27,6 +27,21 @@ class Entry(NamedTuple):
     value: bytes
 
 
+class TTLEntry(NamedTuple):
+    """Mutation-path entry with a cell TTL in seconds (reference: cell-TTL
+    metadata attached in prepareCommit, honored by stores declaring
+    features.cell_ttl). Reads always return plain ``Entry``; stores without
+    cell-TTL support ignore the ttl field."""
+    column: bytes
+    value: bytes
+    ttl: float
+
+
+def entry_ttl(e) -> float:
+    """TTL seconds of a mutation entry (0 = never expires)."""
+    return e.ttl if type(e) is TTLEntry else 0.0
+
+
 EntryList = list  # list[Entry], ordered by column ascending
 
 

@@ -16,9 +16,10 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time as _time
 from typing import Iterator, Optional, Sequence
 
-from titan_tpu.storage.api import (Entry, EntryList, KCVMutation, KeyColumnValueStore,
+from titan_tpu.storage.api import (Entry, EntryList, KCVMutation, KeyColumnValueStore, entry_ttl,
                                    KeyColumnValueStoreManager, KeyRangeQuery,
                                    KeySliceQuery, SliceQuery, StoreFeatures,
                                    StoreTransaction, TransactionHandleConfig,
@@ -26,11 +27,14 @@ from titan_tpu.storage.api import (Entry, EntryList, KCVMutation, KeyColumnValue
 
 
 class _Row:
-    __slots__ = ("columns", "values")
+    __slots__ = ("columns", "values", "expires", "ttl_cells")
 
     def __init__(self):
         self.columns: list[bytes] = []
         self.values: list[bytes] = []
+        # wall-clock expiry per column; 0.0 = never (cell TTL support)
+        self.expires: list[float] = []
+        self.ttl_cells = 0   # count of cells with an expiry; 0 skips scans
 
     def mutate(self, additions: Sequence[Entry], deletions: Sequence[bytes]):
         for col in deletions:
@@ -38,20 +42,53 @@ class _Row:
             if i < len(self.columns) and self.columns[i] == col:
                 del self.columns[i]
                 del self.values[i]
-        for col, val in additions:
+                if self.expires[i]:
+                    self.ttl_cells -= 1
+                del self.expires[i]
+        now = _time.time()
+        for e in additions:
+            col, val = e.column, e.value
+            ttl = entry_ttl(e)
+            exp = now + ttl if ttl > 0 else 0.0
             i = bisect.bisect_left(self.columns, col)
             if i < len(self.columns) and self.columns[i] == col:
                 self.values[i] = val
+                self.ttl_cells += bool(exp) - bool(self.expires[i])
+                self.expires[i] = exp
             else:
                 self.columns.insert(i, col)
                 self.values.insert(i, val)
+                self.expires.insert(i, exp)
+                self.ttl_cells += bool(exp)
 
     def slice(self, q: SliceQuery) -> EntryList:
         lo = bisect.bisect_left(self.columns, q.start)
         hi = bisect.bisect_left(self.columns, q.end) if q.end is not None else len(self.columns)
-        if q.limit is not None:
-            hi = min(hi, lo + q.limit)
-        return [Entry(c, v) for c, v in zip(self.columns[lo:hi], self.values[lo:hi])]
+        if not self.ttl_cells:
+            out = [Entry(c, v) for c, v in zip(self.columns[lo:hi],
+                                               self.values[lo:hi])]
+            return out[:q.limit] if q.limit is not None else out
+        now = _time.time()
+        out = []
+        for c, v, exp in zip(self.columns[lo:hi], self.values[lo:hi],
+                             self.expires[lo:hi]):
+            if exp and exp <= now:
+                continue  # expired cell: lazily hidden, purged on next mutate
+            out.append(Entry(c, v))
+            if q.limit is not None and len(out) >= q.limit:
+                break
+        return out
+
+    def purge_expired(self, now: float) -> None:
+        if not self.ttl_cells:
+            return   # no TTL'd cells: stays O(1) on the hot write path
+        live = [i for i, exp in enumerate(self.expires)
+                if not exp or exp > now]
+        if len(live) != len(self.columns):
+            self.columns = [self.columns[i] for i in live]
+            self.values = [self.values[i] for i in live]
+            self.expires = [self.expires[i] for i in live]
+            self.ttl_cells = sum(1 for exp in self.expires if exp)
 
     @property
     def empty(self) -> bool:
@@ -85,6 +122,7 @@ class InMemoryStore(KeyColumnValueStore):
                 self._rows[key] = row
                 self._sorted_keys = None
             row.mutate(additions, deletions)
+            row.purge_expired(_time.time())
             if row.empty:
                 del self._rows[key]
                 self._sorted_keys = None
@@ -139,7 +177,7 @@ class InMemoryStoreManager(KeyColumnValueStoreManager):
         return StoreFeatures(ordered_scan=True, unordered_scan=True,
                              key_ordered=True, batch_mutation=True,
                              multi_query=True, key_consistent=True,
-                             persists=False)
+                             persists=False, cell_ttl=True)
 
     def open_database(self, name: str) -> InMemoryStore:
         with self._lock:

@@ -272,6 +272,11 @@ class LogManager:
                 log = KCVSLog(name, self._store, self._manager, self._rid,
                               self._times, **kw)
                 self._logs[name] = log
+            elif "read_interval_ms" in overrides:
+                # the cached instance must honor a caller's interval — the
+                # reader loops re-read this attribute every poll, so the
+                # change takes effect immediately
+                log._read_interval = overrides["read_interval_ms"] / 1000.0
             return log
 
     def close(self) -> None:

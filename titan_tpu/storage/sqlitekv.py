@@ -19,10 +19,11 @@ from __future__ import annotations
 import os
 import sqlite3
 import threading
+import time as _time
 from typing import Iterator, Optional, Sequence
 
 from titan_tpu.errors import PermanentBackendError, TemporaryBackendError
-from titan_tpu.storage.api import (Entry, EntryList, KeyColumnValueStore,
+from titan_tpu.storage.api import (Entry, EntryList, KeyColumnValueStore, entry_ttl,
                                    KeyColumnValueStoreManager, KeyRangeQuery,
                                    KeySliceQuery, SliceQuery, StoreFeatures,
                                    StoreTransaction, TransactionHandleConfig)
@@ -119,9 +120,13 @@ class SqliteStore(KeyColumnValueStore):
     def _create_sql(self) -> str:
         return (f"CREATE TABLE IF NOT EXISTS {self._table} "
                 f"(k BLOB NOT NULL, c BLOB NOT NULL, v BLOB NOT NULL, "
+                f"e REAL, "
                 f"PRIMARY KEY (k, c)) WITHOUT ROWID")
 
     def _ensure(self, txh: StoreTransaction) -> None:
+        # migration first: it ALTERs via the shared connection, and must land
+        # before the tx connection opens its read snapshot in ensure_table
+        self._manager._migrate_ttl_column(self._table)
         if isinstance(txh, SqliteTransaction):
             txh.ensure_table(self._table, self._create_sql)
         else:
@@ -149,7 +154,9 @@ class SqliteStore(KeyColumnValueStore):
         q = query.slice
         params: list = [query.key]
         ccond = self._bounds("c", q.start, q.end, params)
-        sql = f"SELECT c, v FROM {self._table} WHERE k = ? AND {ccond} ORDER BY c ASC"
+        sql = (f"SELECT c, v FROM {self._table} WHERE k = ? AND {ccond} "
+               f"AND (e IS NULL OR e > ?) ORDER BY c ASC")
+        params.append(_time.time())
         if q.limit is not None:
             sql += " LIMIT ?"
             params.append(q.limit)
@@ -165,8 +172,10 @@ class SqliteStore(KeyColumnValueStore):
             params: list = list(chunk)
             ccond = self._bounds("c", slice_query.start, slice_query.end, params)
             placeholders = ",".join("?" * len(chunk))
+            params.append(_time.time())
             sql = (f"SELECT k, c, v FROM {self._table} WHERE k IN ({placeholders}) "
-                   f"AND {ccond} ORDER BY k ASC, c ASC")
+                   f"AND {ccond} AND (e IS NULL OR e > ?) "
+                   f"ORDER BY k ASC, c ASC")
             for k, c, v in self._execute(txh, sql, params):
                 lst = out[bytes(k)]
                 if limit is None or len(lst) < limit:
@@ -179,16 +188,23 @@ class SqliteStore(KeyColumnValueStore):
         if self._manager.read_only:
             raise PermanentBackendError("backend opened read-only")
         del_sql = f"DELETE FROM {self._table} WHERE k = ? AND c = ?"
-        add_sql = f"INSERT OR REPLACE INTO {self._table}(k, c, v) VALUES (?, ?, ?)"
+        add_sql = (f"INSERT OR REPLACE INTO {self._table}(k, c, v, e) "
+                   f"VALUES (?, ?, ?, ?)")
+        now = _time.time()
+
+        def row(e):
+            ttl = entry_ttl(e)
+            return (key, e.column, e.value, now + ttl if ttl > 0 else None)
+
         self._ensure(txh)
         if isinstance(txh, SqliteTransaction):
             conn = txh.connection()
             conn.executemany(del_sql, [(key, c) for c in deletions])
-            conn.executemany(add_sql, [(key, e.column, e.value) for e in additions])
+            conn.executemany(add_sql, [row(e) for e in additions])
         else:
             self._manager._shared_executemany(
                 [(del_sql, [(key, c) for c in deletions]),
-                 (add_sql, [(key, e.column, e.value) for e in additions])])
+                 (add_sql, [row(e) for e in additions])])
 
     def get_keys(self, query, txh: StoreTransaction) -> Iterator:
         """Streaming scan: pages by (key, column) cursor position so the
@@ -210,7 +226,9 @@ class SqliteStore(KeyColumnValueStore):
             params: list = []
             kcond = self._bounds("k", key_lo, key_hi, params)
             ccond = self._bounds("c", sl.start, sl.end, params)
-            sql = (f"SELECT k, c, v FROM {self._table} WHERE {kcond} AND {ccond}")
+            params.append(_time.time())
+            sql = (f"SELECT k, c, v FROM {self._table} WHERE {kcond} AND {ccond} "
+                   f"AND (e IS NULL OR e > ?)")
             if after is not None:
                 sql += " AND (k > ? OR (k = ? AND c > ?))"
                 params.extend([after[0], after[0], after[1]])
@@ -257,6 +275,7 @@ class SqliteStoreManager(KeyColumnValueStoreManager):
         self._shared_lock = threading.RLock()
         self._stores: dict[str, SqliteStore] = {}
         self._tables: set[str] = set()
+        self._ttl_migrated: set[str] = set()
         self._closed = False
 
     # -- connection plumbing -------------------------------------------------
@@ -292,8 +311,23 @@ class SqliteStoreManager(KeyColumnValueStoreManager):
             self._shared.execute(
                 f"CREATE TABLE IF NOT EXISTS {table} "
                 f"(k BLOB NOT NULL, c BLOB NOT NULL, v BLOB NOT NULL, "
+                f"e REAL, "
                 f"PRIMARY KEY (k, c)) WITHOUT ROWID")
             self._tables.add(table)
+
+    def _migrate_ttl_column(self, table: str):
+        """Databases created before the TTL column existed get it added in
+        place (ALTER TABLE); without this, every read/write on old data
+        would fail with 'no such column: e'."""
+        if table in self._ttl_migrated:
+            return
+        with self._shared_lock:
+            cols = [r[1] for r in self._shared.execute(
+                f"PRAGMA table_info({table})").fetchall()]
+            if cols and "e" not in cols:
+                self._shared.execute(f"ALTER TABLE {table} ADD COLUMN e REAL")
+                self._shared.commit()
+            self._ttl_migrated.add(table)
 
     # -- manager SPI ---------------------------------------------------------
 
@@ -306,7 +340,8 @@ class SqliteStoreManager(KeyColumnValueStoreManager):
         return StoreFeatures(ordered_scan=True, unordered_scan=True,
                              key_ordered=True, transactional=True,
                              batch_mutation=True, multi_query=True,
-                             key_consistent=True, persists=True)
+                             key_consistent=True, persists=True,
+                             cell_ttl=True)
 
     def open_database(self, name: str) -> SqliteStore:
         store = self._stores.get(name)
@@ -331,12 +366,16 @@ class SqliteStoreManager(KeyColumnValueStoreManager):
                 store = self.open_database(store_name)
                 self._ensure_table(store._table)
                 del_sql = f"DELETE FROM {store._table} WHERE k = ? AND c = ?"
-                add_sql = (f"INSERT OR REPLACE INTO {store._table}(k, c, v) "
-                           f"VALUES (?, ?, ?)")
+                add_sql = (f"INSERT OR REPLACE INTO {store._table}(k, c, v, e) "
+                           f"VALUES (?, ?, ?, ?)")
+                now = _time.time()
                 dels, adds = [], []
                 for key, m in by_key.items():
                     dels.extend((key, c) for c in m.deletions)
-                    adds.extend((key, e.column, e.value) for e in m.additions)
+                    adds.extend(
+                        (key, e.column, e.value,
+                         now + t if (t := entry_ttl(e)) > 0 else None)
+                        for e in m.additions)
                 batches.append((del_sql, dels))
                 batches.append((add_sql, adds))
             self._shared_executemany(batches)
