@@ -119,6 +119,28 @@ def test_static_vertex_label_ttl(graph):
     tx3.rollback()
 
 
+def test_static_label_blocks_later_modification(graph):
+    """Static vertices cannot be modified after the creating tx (reference:
+    VertexLabel static semantics) — the invariant vertex TTL relies on."""
+    from titan_tpu.errors import SchemaViolationError
+    mgmt = graph.management()
+    mgmt.make_vertex_label("frozen", static=True)
+    mgmt.commit()
+    tx = graph.new_transaction()
+    v = tx.add_vertex("frozen", note="initial")   # creating tx: allowed
+    vid = v.id
+    tx.commit()
+    tx2 = graph.new_transaction()
+    v2 = tx2.vertex(vid)
+    with pytest.raises(SchemaViolationError):
+        v2.property("note", "changed")
+    with pytest.raises(SchemaViolationError):
+        v2.remove()
+    with pytest.raises(SchemaViolationError):
+        tx2.add_vertex("person", name="x").add_edge("sees", v2)
+    tx2.rollback()
+
+
 def test_expired_vertex_frees_unique_index(graph):
     """Composite index entries expire WITH their element: a unique name can
     be reused after the TTL'd vertex is gone (no permanent ghost row)."""

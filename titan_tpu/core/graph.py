@@ -244,7 +244,7 @@ class StandardGraph:
                 if t > 0:
                     label_ttl[vid] = t
 
-        def entry_with_ttl(rel, entry: Entry, row_vid: int) -> Entry:
+        def entry_with_ttl(rel, entry: Entry) -> Entry:
             from titan_tpu.storage.api import TTLEntry
             ttls = [self.schema.ttl_of(rel.type_id)]
             ttls.append(label_ttl.get(rel.out_vertex_id, 0.0))
@@ -274,7 +274,7 @@ class StandardGraph:
         for rel in tx._added.values():
             locked = self._needs_lock(rel)
             for vid, entry in self._serialize(rel):
-                add(vid, entry_with_ttl(rel, entry, vid))
+                add(vid, entry_with_ttl(rel, entry))
                 if locked:
                     lock_targets.setdefault(
                         (self.idm.key_bytes(vid), entry.column), None)

@@ -406,6 +406,28 @@ class SchemaManager:
     def _name_index_key(self, name: str) -> bytes:
         return _NAME_INDEX_PREFIX + name.encode("utf-8")
 
+    def all_types(self) -> list:
+        """Every declared user schema type, loaded from the name index
+        (reference: ManagementSystem.getRelationTypes/getVertexLabels)."""
+        backend = self._graph.backend
+        from titan_tpu.storage.api import KeyRangeQuery
+        lo = _NAME_INDEX_PREFIX
+        hi = _NAME_INDEX_PREFIX[:-1] + \
+            bytes([_NAME_INDEX_PREFIX[-1] + 1])
+        txh = backend.manager.begin_transaction()
+        out = []
+        try:
+            for key, entries in backend.index_store.store.get_keys(
+                    KeyRangeQuery(lo, hi, SliceQuery()), txh):
+                for e in entries:
+                    if e.column == _NAME_COLUMN:
+                        st = self.get_type(int.from_bytes(e.value, "big"))
+                        if st is not None:
+                            out.append(st)
+        finally:
+            txh.commit()
+        return sorted(out, key=lambda t: t.id)
+
     def _store_type(self, st: SchemaType, expect_new: bool = True) -> SchemaType:
         if expect_new and self.get_by_name(st.name) is not None:
             raise SchemaViolationError(f"schema name already exists: {st.name!r}")

@@ -266,6 +266,18 @@ class GraphTransaction:
     def _check_vertex_writable(self, vid: int):
         if vid in self._removed_vertices:
             raise InvalidElementError(f"vertex {vid} was removed in this tx")
+        # static vertex labels are immutable after the creating tx
+        # (reference: VertexLabel.isStatic — required for vertex TTL, since
+        # later writes would outlive the original cells)
+        if vid not in self._new_vertices and self.idm.is_user_vertex_id(vid):
+            self.vertex_label_name(vid)      # populate the label cache
+            lid = self._vertex_labels.get(vid) or 0
+            if lid:
+                st = self.schema.get_type(lid)
+                if st is not None and getattr(st, "static", False):
+                    raise SchemaViolationError(
+                        f"vertex {vid} has static label {st.name!r} and "
+                        "cannot be modified after creation")
 
     def remove_relation(self, rel: InternalRelation) -> None:
         self._check_open()
@@ -287,6 +299,8 @@ class GraphTransaction:
         self._check_open()
         if self.read_only:
             raise SchemaViolationError("read-only transaction")
+        if v.id not in self._removed_vertices:
+            self._check_vertex_writable(v.id)
         # delete every incident relation (incl. existence + label edge)
         for rel in list(self._iter_relations(v.id, Direction.BOTH, None,
                                              RelationCategory.RELATION,

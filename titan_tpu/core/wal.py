@@ -153,9 +153,15 @@ class TransactionRecovery:
                     continue
                 store = backend.manager.open_database(store_name)
                 for key, (adds, dels) in by_key.items():
-                    # adds may carry a third TTL element (TTLEntry rows)
-                    store.mutate(key, [Entry(a[0], a[1]) for a in adds],
-                                 list(dels), txh)
+                    # a third element is the cell TTL (TTLEntry rows) —
+                    # preserve it so recovered cells still expire (the clock
+                    # restarts at replay time: at-least-lifetime semantics)
+                    from titan_tpu.storage.api import TTLEntry
+                    store.mutate(
+                        key,
+                        [TTLEntry(a[0], a[1], a[2]) if len(a) > 2 and a[2]
+                         else Entry(a[0], a[1]) for a in adds],
+                        list(dels), txh)
             txh.commit()
             self.recovered += 1
         except BaseException:

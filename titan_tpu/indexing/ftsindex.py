@@ -269,6 +269,9 @@ class FTSIndex(IndexProvider):
 
     def clear_storage(self) -> None:
         with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS keyinfo "
+                "(store TEXT, key TEXT, info BLOB, PRIMARY KEY (store, key))")
             tables = [r[0] for r in self._conn.execute(
                 "SELECT name FROM sqlite_master WHERE type='table' AND "
                 "(name LIKE 'd\\_%' ESCAPE '\\')").fetchall()]

@@ -57,6 +57,46 @@ class GhostVertexRemover(ScanJob):
             raise
 
 
+class VertexCountJob(ScanJob):
+    """Counts live user vertices and their OUT edges — the smallest useful
+    ScanJob, and the shared fixture for the split-runner suites (reference:
+    titan-test diskstorage/SimpleScanJob.java:25 — the configurable job run
+    both in-process and on MapReduce)."""
+
+    VERTICES = "vertex-count"
+    EDGES = "edge-count"
+
+    def __init__(self, graph):
+        self.graph = graph
+        [self._exists_q] = graph.codec.query_type(
+            graph.schema.system.vertex_exists, Direction.OUT, graph.schema)
+        self._all_q = SliceQuery()
+
+    def get_queries(self):
+        return [self._all_q, self._exists_q]
+
+    def process(self, key: bytes, entries_by_query: dict,
+                metrics: ScanMetrics) -> None:
+        from titan_tpu.core.defs import RelationCategory
+        vid = self.graph.idm.id_of_key_bytes(key)
+        if not self.graph.idm.is_user_vertex_id(vid):
+            return
+        if not entries_by_query[self._exists_q]:
+            return
+        metrics.increment(self.VERTICES)
+        for e in entries_by_query[self._all_q]:
+            rc = self.graph.codec.parse(e, self.graph.schema)
+            if rc.category is RelationCategory.EDGE and \
+                    rc.direction is Direction.OUT and \
+                    not self.graph.schema.system.is_system(rc.type_id):
+                metrics.increment(self.EDGES)
+
+
+def make_vertex_count_job(graph):
+    """Worker-side factory for the split runners (ScanJobSpec target)."""
+    return VertexCountJob(graph)
+
+
 def remove_ghost_vertices(graph, num_threads: int = 2) -> int:
     """Run the ghost remover over the edgestore; returns vertices removed."""
     from titan_tpu.storage.scan import StandardScanner

@@ -84,10 +84,14 @@ class P:
         # reference Text.CONTAINS: the value must contain ALL terms of the
         # (tokenized) query; a token-less query matches nothing
         toks = [t for t in re.split(r"\W+", query.lower()) if t]
-        return P("textContains", query,
-                 lambda c: bool(toks)
-                 and all(t in set(re.split(r"\W+", str(c).lower()))
-                         for t in toks))
+
+        def _test(c, _toks=toks):
+            if not _toks:
+                return False
+            words = set(re.split(r"\W+", str(c).lower()))
+            return all(t in words for t in _toks)
+
+        return P("textContains", query, _test)
 
     @staticmethod
     def text_prefix(prefix: str):

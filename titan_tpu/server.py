@@ -1,0 +1,228 @@
+"""HTTP graph server + console — the deployment surface.
+
+(reference: titan-dist/src/assembly/static — Gremlin Server wired to a Titan
+graph via gremlin-server.yaml (conf/gremlin-server/gremlin-server.yaml), the
+``gremlin.sh`` console with the Titan plugin
+(titan-all/.../TitanGremlinPlugin.java:18), and ``titan.sh`` start/stop.
+The rebuild keeps the same shape — a long-running server process hosting an
+open graph and evaluating traversal scripts submitted by clients, plus an
+interactive console — on stdlib HTTP + JSON instead of Netty/Gremlin-wire.)
+
+Endpoints:
+  GET  /status      — instance id, backend, vertex-program computer, metrics
+  GET  /schema      — declared schema types
+  POST /traversal   — {"gremlin": "g.V().has('name','x').out().count()"}
+                      evaluated against bindings {g, P, graph}; like Gremlin
+                      Server's script engine, the endpoint executes caller
+                      scripts — deploy it only where the caller is trusted.
+
+Server config is a YAML file (gremlin-server.yaml analog):
+  host: 127.0.0.1
+  port: 8182
+  graph:
+    storage.backend: sqlite
+    storage.directory: /data/graph
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from titan_tpu.core.elements import Edge, Vertex, VertexProperty
+
+_EVAL_TIMEOUT_NOTE = "script evaluation runs in-request"
+
+
+def jsonify(obj: Any, max_depth: int = 4) -> Any:
+    """Traversal results → JSON-safe structures (GraphSON-flavored
+    element envelopes; reference: TitanIoRegistry / GraphSON mapping)."""
+    if max_depth < 0:
+        return str(obj)
+    if isinstance(obj, Vertex):
+        return {"@type": "vertex", "id": obj.id, "label": obj.label()}
+    if isinstance(obj, Edge):
+        return {"@type": "edge", "id": obj.id, "label": obj.label(),
+                "outV": obj.out_vertex().id, "inV": obj.in_vertex().id}
+    if isinstance(obj, VertexProperty):
+        return {"@type": "property", "key": obj.key(), "value": obj.value}
+    if isinstance(obj, dict):
+        return {str(k): jsonify(v, max_depth - 1) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [jsonify(v, max_depth - 1) for v in obj]
+    if isinstance(obj, (int, float, str, bool)) or obj is None:
+        return obj
+    return str(obj)
+
+
+class GraphServer:
+    """Hosts one open graph; evaluate() is the script-engine seam."""
+
+    def __init__(self, graph, host: str = "127.0.0.1", port: int = 8182):
+        self.graph = graph
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- script evaluation ---------------------------------------------------
+
+    def evaluate(self, script: str) -> Any:
+        """One traversal script against fresh bindings; the thread-bound tx
+        commits on success, rolls back on error (Gremlin Server's
+        per-request transaction semantics)."""
+        from titan_tpu.query.predicates import P
+        bindings = {"g": self.graph.traversal(), "graph": self.graph,
+                    "P": P, "__builtins__": {"len": len, "list": list,
+                                             "range": range, "sorted": sorted,
+                                             "min": min, "max": max,
+                                             "sum": sum}}
+        try:
+            result = eval(script, bindings)  # noqa: S307 — script endpoint
+            from titan_tpu.traversal.dsl import Traversal
+            if isinstance(result, Traversal):
+                result = result.to_list()
+            self.graph.commit()
+            return result
+        except BaseException:
+            self.graph.rollback()
+            raise
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "GraphServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet
+                pass
+
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    self._do_get()
+                except BaseException as e:
+                    # same JSON-error contract as /traversal — never drop
+                    # the connection on a backend hiccup
+                    try:
+                        self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    except OSError:
+                        pass
+
+            def _do_get(self):
+                if self.path == "/status":
+                    from titan_tpu.config import defaults as d
+                    g = server.graph
+                    metrics = {}
+                    if g._metrics is not None:
+                        metrics = {k: v for k, v in
+                                   g._metrics.snapshot().items()
+                                   if isinstance(v, int)}
+                    self._send(200, {
+                        "instance": g.instance_id,
+                        "backend": g.backend.manager.name,
+                        "computer": g.config.get(d.COMPUTER_BACKEND),
+                        "metrics": metrics})
+                elif self.path == "/schema":
+                    types = server.graph.schema.all_types()
+                    self._send(200, {"types": [
+                        {"name": t.name, "id": t.id,
+                         "kind": type(t).__name__} for t in types]})
+                else:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/traversal":
+                    self._send(404, {"error": f"unknown path {self.path}"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    script = req["gremlin"]
+                except (json.JSONDecodeError, KeyError):
+                    self._send(400, {"error": "body must be JSON with a "
+                                              "'gremlin' field"})
+                    return
+                try:
+                    result = server.evaluate(script)
+                except BaseException as e:
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._send(200, {"result": jsonify(result)})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]   # resolve port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="titan-tpu-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def from_yaml(path: str) -> GraphServer:
+    """gremlin-server.yaml analog → a ready (unstarted) GraphServer."""
+    import yaml
+
+    import titan_tpu
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    graph = titan_tpu.open(cfg.get("graph") or {})
+    return GraphServer(graph, host=cfg.get("host", "127.0.0.1"),
+                       port=int(cfg.get("port", 8182)))
+
+
+def console(config) -> None:
+    """Interactive console with an open graph bound as ``g``/``graph``
+    (reference: gremlin.sh + TitanGremlinPlugin console imports)."""
+    import code
+
+    import titan_tpu
+    from titan_tpu.query.predicates import P
+    graph = titan_tpu.open(config)
+    banner = (f"titan_tpu console — graph open on "
+              f"{graph.backend.manager.name}\n"
+              f"bindings: graph, g (traversal), P (predicates), mgmt")
+    try:
+        code.interact(banner=banner, local={
+            "graph": graph, "g": graph.traversal(), "P": P,
+            "mgmt": graph.management()})
+    finally:
+        graph.close()
+
+
+def main(argv: Optional[list] = None) -> None:
+    """``python -m titan_tpu.server conf.yaml`` or
+    ``python -m titan_tpu.server --console inmemory``."""
+    import sys
+    args = list(sys.argv[1:] if argv is None else argv)
+    if args and args[0] == "--console":
+        console(args[1] if len(args) > 1 else "inmemory")
+        return
+    if not args:
+        print("usage: python -m titan_tpu.server <conf.yaml> | "
+              "--console <backend>", file=sys.stderr)
+        raise SystemExit(2)
+    server = from_yaml(args[0]).start()
+    print(f"titan_tpu server listening on {server.host}:{server.port}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,11 @@ class StandardScanner:
 
     def execute(self, job: ScanJob, graph=None, config: Optional[dict] = None,
                 num_threads: int = 4, queue_size: int = 1024,
-                block_size: int = 1000) -> ScanMetrics:
+                block_size: int = 1000,
+                key_range: Optional[tuple] = None) -> ScanMetrics:
+        """``key_range=(start, end)`` restricts the scan to one key split —
+        the distributed runner's unit of work (reference: HadoopScanMapper
+        processing one input split)."""
         metrics = ScanMetrics()
         job.setup(graph, config or {}, metrics)
         queries = list(job.get_queries())
@@ -44,6 +48,11 @@ class StandardScanner:
         ends = [q.end for q in queries]
         cover = SliceQuery(min(starts),
                            None if any(e is None for e in ends) else max(ends))
+        if key_range is not None:
+            from titan_tpu.storage.api import KeyRangeQuery
+            scan_query = KeyRangeQuery(key_range[0], key_range[1], cover)
+        else:
+            scan_query = cover
 
         rows: _queue.Queue = _queue.Queue(maxsize=queue_size)
         errors: list[BaseException] = []
@@ -51,7 +60,7 @@ class StandardScanner:
         def puller():
             txh = self._manager.begin_transaction()
             try:
-                for key, entries in self._store.get_keys(cover, txh):
+                for key, entries in self._store.get_keys(scan_query, txh):
                     rows.put((key, entries))
             except BaseException as e:  # surface on the main thread
                 errors.append(e)

@@ -63,13 +63,18 @@ class SqliteTransaction(StoreTransaction):
         self._lock = threading.Lock()
         self.closed = False
 
-    def connection(self) -> sqlite3.Connection:
+    def connection(self, write: bool = False) -> sqlite3.Connection:
+        # write txs take the write lock UP FRONT (BEGIN IMMEDIATE): a
+        # deferred tx that upgrades read→write mid-flight gets SQLITE_BUSY
+        # *immediately* (no busy-wait) when another process holds the lock —
+        # fatal for multi-process scan/reindex workers. Read-first txs stay
+        # deferred so concurrent WAL readers never serialize.
         with self._lock:
             if self.closed:
                 raise PermanentBackendError("transaction already closed")
             if self._conn is None:
                 self._conn = self._manager._new_connection()
-                self._conn.execute("BEGIN")
+                self._conn.execute("BEGIN IMMEDIATE" if write else "BEGIN")
             return self._conn
 
     def ensure_table(self, table: str, create_sql: str) -> None:
@@ -196,12 +201,18 @@ class SqliteStore(KeyColumnValueStore):
             ttl = entry_ttl(e)
             return (key, e.column, e.value, now + ttl if ttl > 0 else None)
 
-        self._ensure(txh)
         if isinstance(txh, SqliteTransaction):
-            conn = txh.connection()
+            # the write connection must be requested BEFORE ensure_table
+            # opens it deferred, or BEGIN IMMEDIATE never happens and the
+            # tx upgrades read→write (immediate SQLITE_BUSY under
+            # multi-process contention)
+            self._manager._migrate_ttl_column(self._table)
+            conn = txh.connection(write=True)
+            txh.ensure_table(self._table, self._create_sql)
             conn.executemany(del_sql, [(key, c) for c in deletions])
             conn.executemany(add_sql, [row(e) for e in additions])
         else:
+            self._ensure(txh)
             self._manager._shared_executemany(
                 [(del_sql, [(key, c) for c in deletions]),
                  (add_sql, [row(e) for e in additions])])
