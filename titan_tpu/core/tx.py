@@ -283,6 +283,11 @@ class GraphTransaction:
         self._check_open()
         if self.read_only:
             raise SchemaViolationError("read-only transaction")
+        # removing a relation modifies BOTH endpoint vertices — static
+        # (immutable-after-creation) endpoints forbid it
+        for vid in rel.vertex_ids():
+            if vid is not None and not self.idm.is_schema_id(vid):
+                self._check_vertex_writable(vid)
         if rel.relation_id in self._added:
             del self._added[rel.relation_id]
             for vid in rel.vertex_ids():

@@ -160,7 +160,14 @@ class InProcessSplitRunner:
         self.num_workers = num_workers
         self.store = store
 
-    def run(self, spec_or_job, idm=None) -> ScanMetrics:
+    def run(self, spec: ScanJobSpec, idm=None) -> ScanMetrics:
+        if not isinstance(spec, ScanJobSpec):
+            # a live job instance would be SHARED by the worker threads —
+            # concurrent setup()/process() on one stateful job corrupts it
+            raise TypeError(
+                "InProcessSplitRunner needs a ScanJobSpec (one job instance "
+                "is built per split); got "
+                f"{type(spec).__name__}")
         from titan_tpu.storage.scan import StandardScanner
         graph = self.graph
         splits = key_splits(graph.idm, self.num_workers)
@@ -171,8 +178,7 @@ class InProcessSplitRunner:
         metrics = ScanMetrics()
 
         def one(key_range):
-            job = spec_or_job.build(graph) \
-                if isinstance(spec_or_job, ScanJobSpec) else spec_or_job
+            job = spec.build(graph)
             m = scanner.execute(job, graph=graph, num_threads=1,
                                 key_range=key_range)
             return dict(m._counts)

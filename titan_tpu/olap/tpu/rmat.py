@@ -28,10 +28,13 @@ def rmat_edges(scale: int, edge_factor: int = 16, seed: int = 1,
         s = np.zeros(m, dtype=dtype)
         t = np.zeros(m, dtype=dtype)
         for bit in range(scale):
-            down = rng.random(m) > ab          # go to lower half (rows)
-            right_top = rng.random(m) > a_norm
-            right_bot = rng.random(m) > c_norm
-            right = np.where(down, right_bot, right_top)
+            # two float32 draws per bit: one for the row half, one shared
+            # for the column (its threshold is selected by `down`, and
+            # conditioned on `down` the uniform is independent — same
+            # distribution as three draws at ~1/3 the rng cost)
+            down = rng.random(m, dtype=np.float32) > ab
+            u = rng.random(m, dtype=np.float32)
+            right = np.where(down, u > c_norm, u > a_norm)
             s |= (down.astype(dtype) << bit)
             t |= (right.astype(dtype) << bit)
         # scramble to break locality (Graph500 permutes vertex ids)

@@ -1,19 +1,21 @@
 """Segment-reduction kernels — the SpMV primitive of the OLAP engine.
 
-Two implementations of "combine per-edge messages by destination":
+Three implementations of "combine per-edge messages by destination",
+selected by ``TITAN_TPU_SEGMENT_KERNEL`` (see PERF_NOTES.md for the full
+on-device measurement story — beware `block_until_ready` not syncing
+through the device tunnel and XLA constant-folding jit-captured inputs;
+only readback-synced, argument-passed benchmarks are real):
 
-* ``jax.ops.segment_*`` — lowers to scatter; fine on CPU, but XLA TPU
-  lowers scatters to a serial per-element loop (measured ~20M updates/s on
-  v5e), which would dominate every superstep.
-* sorted-segment Hillis-Steele scan — snapshots store edges dst-sorted, so
-  the combine is an inclusive SEGMENTED SCAN (log₂E fully-vectorized passes
-  over the edge axis) followed by picking each segment's last element
-  (positions are static, precomputed from the CSR indptr). Measured ~0.5ms
-  for 8M edges on v5e — ~500× the scatter path. This is the TPU-native
-  kernel (SURVEY §7: MessageCombiner → segment reductions).
-
-``segment_combine`` picks the scan path whenever segment metadata
-(``last_idx``/``seg_has``) is provided and the backend is not CPU.
+* ``scan`` (DEFAULT on non-CPU backends when segment metadata is present):
+  sorted-segment Hillis-Steele scan + static last-index gather. At real
+  scale (268M edges, v5e, readback-synced): scan 330ms + last-gather 270ms
+  vs 3 275ms for the scatter path — ~5× faster.
+* ``native`` (and the CPU default): ``jax.ops.segment_*`` scatter — XLA's
+  TPU scatter lowering runs at a flat ~100M elem/s, but it is the right
+  path on CPU and for unsorted segments.
+* ``pallas`` (opt-in): one-pass streamed scan (ops/pallas_segment.py),
+  currently lane-shift-bound, ~par with the XLA scan; retained as the
+  kernel substrate for future tuning.
 """
 
 from __future__ import annotations
@@ -87,9 +89,15 @@ def sorted_segment_combine(values, seg_ids, last_idx, seg_has, combine: str):
 def segment_combine(values, segment_ids, num_segments: int, combine: str,
                     indices_are_sorted: bool = True,
                     last_idx=None, seg_has=None):
-    use_scan = (last_idx is not None and seg_has is not None and
-                jax.default_backend() != "cpu")
-    if use_scan:
+    import os
+    kernel = os.environ.get("TITAN_TPU_SEGMENT_KERNEL", "scan")
+    has_meta = last_idx is not None and seg_has is not None
+    if has_meta and kernel == "pallas" and jax.default_backend() == "tpu":
+        from titan_tpu.ops.pallas_segment import \
+            pallas_sorted_segment_combine
+        return pallas_sorted_segment_combine(
+            values, segment_ids, last_idx, seg_has, combine)
+    if has_meta and kernel == "scan" and jax.default_backend() != "cpu":
         return sorted_segment_combine(values, segment_ids, last_idx, seg_has,
                                       combine)
     try:

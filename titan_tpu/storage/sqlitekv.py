@@ -68,7 +68,12 @@ class SqliteTransaction(StoreTransaction):
         # deferred tx that upgrades read→write mid-flight gets SQLITE_BUSY
         # *immediately* (no busy-wait) when another process holds the lock —
         # fatal for multi-process scan/reindex workers. Read-first txs stay
-        # deferred so concurrent WAL readers never serialize.
+        # deferred so concurrent WAL readers never serialize. NOTE: the flag
+        # only matters on the FIRST call — a tx already opened deferred by a
+        # read cannot upgrade its BEGIN; such read-then-write txs keep the
+        # upgrade risk and rely on caller-level retries (the split runners
+        # retry idempotent work; the graph commit path retries via
+        # BackendOperation).
         with self._lock:
             if self.closed:
                 raise PermanentBackendError("transaction already closed")
