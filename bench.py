@@ -25,8 +25,7 @@ def main() -> None:
     scale = int(sys.argv[1]) if len(sys.argv) > 1 else (23 if on_accel else 16)
     edge_factor = 16
 
-    from titan_tpu.models.bfs import BFS, INF
-    from titan_tpu.olap.tpu.engine import TPUGraphComputer
+    from titan_tpu.models.bfs import INF, frontier_bfs
     from titan_tpu.olap.tpu.rmat import rmat_edges
     from titan_tpu.olap.tpu import snapshot as snap_mod
 
@@ -39,28 +38,24 @@ def main() -> None:
     snap = snap_mod.from_arrays(n, s2, d2)
     gen_s = time.time() - t0
 
-    comp = TPUGraphComputer(snapshot=snap, num_devices=1)
     # pick a source with out-degree > 0 (Graph500 rule)
     deg = snap.out_degree
     source = int(np.flatnonzero(deg > 0)[0])
 
-    prog = BFS(max_iterations=64)
-    params = {"source_dense": source}
+    # frontier-sparse BFS (O(E) total work; see PERF_NOTES.md)
     # warm-up / compile + converged run
     t1 = time.time()
-    res = comp.run(prog, params=params, snapshot=snap)
+    dist, iters = frontier_bfs(snap, source)
     first_s = time.time() - t1
-    iters = res.iterations
 
     # timed runs (compile cached)
     times = []
     for _ in range(3):
         t2 = time.time()
-        res = comp.run(prog, params=params, snapshot=snap)
+        dist, iters = frontier_bfs(snap, source)
         times.append(time.time() - t2)
     t_bfs = min(times)
 
-    dist = res["dist"]
     reachable = dist < int(INF)
     # Graph500 TEPS: input (undirected) edges with both endpoints reachable
     m_traversed = int(np.count_nonzero(reachable[s2]) // 2)

@@ -91,6 +91,9 @@ def segment_combine(values, segment_ids, num_segments: int, combine: str,
                     last_idx=None, seg_has=None):
     import os
     kernel = os.environ.get("TITAN_TPU_SEGMENT_KERNEL", "scan")
+    if kernel not in ("scan", "native", "pallas"):
+        raise ValueError(
+            f"TITAN_TPU_SEGMENT_KERNEL={kernel!r}: expected scan|native|pallas")
     has_meta = last_idx is not None and seg_has is not None
     if has_meta and kernel == "pallas" and jax.default_backend() == "tpu":
         from titan_tpu.ops.pallas_segment import \
