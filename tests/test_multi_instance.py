@@ -1,0 +1,186 @@
+"""Multi-instance graphs over one shared storage backend.
+
+Modeled on the reference's eventual-consistency and concurrency coverage
+(titan-test TitanEventualGraphTest / TitanGraphConcurrentTest and the
+instance-registry behaviors in ManagementSystem): Titan instances never
+talk to each other directly — all coordination flows through the shared
+store — so two graph handles over the same sqlite directory behave like
+two cluster nodes.
+"""
+
+import threading
+
+import pytest
+
+import titan_tpu
+from titan_tpu.errors import TitanError
+
+
+@pytest.fixture
+def shared_dir(tmp_path):
+    return str(tmp_path / "db")
+
+
+def _open(shared_dir, instance=None, **extra):
+    cfg = {"storage.backend": "sqlite", "storage.directory": shared_dir}
+    if instance:
+        cfg["graph.unique-instance-id"] = instance
+    cfg.update(extra)
+    return titan_tpu.open(cfg)
+
+
+def test_writes_visible_across_instances(shared_dir):
+    g1 = _open(shared_dir, "a")
+    g2 = _open(shared_dir, "b")
+    try:
+        tx = g1.new_transaction()
+        v = tx.add_vertex("person", name="alice")
+        vid = v.id
+        tx.commit()
+        tx2 = g2.new_transaction()
+        got = tx2.vertex(vid)
+        assert got is not None and got.value("name") == "alice"
+        tx2.rollback()
+    finally:
+        g1.close()
+        g2.close()
+
+
+def test_schema_created_by_peer_resolves(shared_dir):
+    g1 = _open(shared_dir, "a")
+    g2 = _open(shared_dir, "b")
+    try:
+        mgmt = g1.management()
+        mgmt.make_edge_label("follows")
+        mgmt.commit()
+        # instance b sees the label by name (loaded through the store)
+        st = g2.schema.get_by_name("follows")
+        assert st is not None and st.is_edge_label
+    finally:
+        g1.close()
+        g2.close()
+
+
+def test_instance_registry_and_eviction(shared_dir):
+    g1 = _open(shared_dir, "node1")
+    g2 = _open(shared_dir, "node2")
+    try:
+        mgmt = g1.management()
+        assert set(mgmt.get_open_instances()) == {"node1", "node2"}
+        with pytest.raises(TitanError):
+            mgmt.force_close_instance("node1")   # not the current one
+    finally:
+        g2.close()
+        g1.close()
+
+
+def test_dead_instance_blocks_id_then_evicts(shared_dir):
+    g1 = _open(shared_dir, "nodeX")
+    g1.backend.manager.close()  # simulate a crash: no deregistration
+    g1._open = False
+    g2 = _open(shared_dir, "alive")
+    try:
+        # the dead instance's registration is still visible...
+        mgmt = g2.management()
+        assert "nodeX" in mgmt.get_open_instances()
+        # ...a new instance reusing the id is refused...
+        with pytest.raises(TitanError):
+            _open(shared_dir, "nodeX")
+        # ...until force-evicted (reference: forceCloseInstance)
+        mgmt.force_close_instance("nodeX")
+        g3 = _open(shared_dir, "nodeX")
+        g3.close()
+    finally:
+        g2.close()
+
+
+def test_id_blocks_disjoint_across_instances(shared_dir):
+    g1 = _open(shared_dir, "a")
+    g2 = _open(shared_dir, "b")
+    try:
+        ids1, ids2 = [], []
+        tx1, tx2 = g1.new_transaction(), g2.new_transaction()
+        for i in range(50):
+            ids1.append(tx1.add_vertex("person", name=f"a{i}").id)
+            ids2.append(tx2.add_vertex("person", name=f"b{i}").id)
+        tx1.commit()
+        tx2.commit()
+        assert not (set(ids1) & set(ids2))
+    finally:
+        g1.close()
+        g2.close()
+
+
+def test_concurrent_commits_from_two_instances(shared_dir):
+    g1 = _open(shared_dir, "a")
+    g2 = _open(shared_dir, "b")
+    errors = []
+
+    def writer(g, tag):
+        try:
+            for i in range(10):
+                tx = g.new_transaction()
+                tx.add_vertex("person", name=f"{tag}{i}")
+                tx.commit()
+        except BaseException as e:   # noqa: BLE001
+            errors.append(e)
+
+    try:
+        t1 = threading.Thread(target=writer, args=(g1, "a"))
+        t2 = threading.Thread(target=writer, args=(g2, "b"))
+        t1.start(); t2.start(); t1.join(); t2.join()
+        assert not errors, errors
+        tx = g1.new_transaction()
+        assert sum(1 for _ in tx.vertices()) == 20
+        tx.rollback()
+    finally:
+        g1.close()
+        g2.close()
+
+
+def test_ghost_rows_after_concurrent_delete(shared_dir):
+    """Eventual-consistency cleanup: instance A deletes a vertex while B
+    already wrote an edge to it; the half-alive remnants are swept by the
+    ghost remover (reference: GhostVertexRemover semantics)."""
+    from titan_tpu.olap.jobs import remove_ghost_vertices
+    g1 = _open(shared_dir, "a")
+    g2 = _open(shared_dir, "b")
+    try:
+        tx = g1.new_transaction()
+        victim = tx.add_vertex("person", name="victim")
+        vid = victim.id
+        tx.commit()
+
+        # B observes the victim alive, A deletes it, then B attaches an
+        # edge in a FRESH tx without re-checking — the edge lands on a
+        # now-dead row (no conflict detected: no locks). (Note: sqlite WAL
+        # refuses read→write upgrades across a peer's commit, so B's stale
+        # observation and its write are separate transactions — which is
+        # also the realistic racing-client shape.)
+        tx_look = g2.new_transaction()
+        assert tx_look.vertex(vid) is not None
+        tx_look.rollback()
+        txa = g1.new_transaction()
+        txa.vertex(vid).remove()
+        txa.commit()
+        txb = g2.new_transaction()
+        w = txb.add_vertex("person", name="writer")
+        txb.add_edge(w, "knows", txb.vertex_handle(vid))
+        txb.commit()
+
+        # the victim row now has relation data but no exists marker
+        tx3 = g1.new_transaction()
+        assert tx3.vertex(vid) is None
+        tx3.rollback()
+        removed = remove_ghost_vertices(g1)
+        assert removed >= 1
+        # sweep leaves a clean store: victim row fully gone
+        from titan_tpu.storage.api import KeySliceQuery, SliceQuery
+        txh = g1.backend.manager.begin_transaction()
+        entries = g1.backend.edge_store.store.get_slice(
+            KeySliceQuery(g1.idm.key_bytes(vid), SliceQuery()), txh)
+        txh.commit()
+        assert entries == []
+    finally:
+        g1.close()
+        g2.close()

@@ -114,7 +114,16 @@ class ManagementSystem:
     def open_instances(self) -> list:
         return self.graph.backend.instance_registry.instances()
 
+    # reference API name
+    get_open_instances = open_instances
+
     def force_close_instance(self, instance_id: str) -> None:
+        """Evict a dead instance's registration (reference:
+        ManagementSystem.forceCloseInstance — for instances that crashed
+        without deregistering)."""
+        if instance_id == self.graph.instance_id:
+            raise TitanError(
+                "cannot force-close the current instance; close the graph")
         self.graph.backend.instance_registry.force_evict(instance_id)
 
     # -- graph indexes (reference: TitanManagement.buildIndex) ---------------

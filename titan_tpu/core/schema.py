@@ -22,12 +22,12 @@ from titan_tpu.codec.attributes import Serializer
 from titan_tpu.codec.edges import EdgeCodec
 from titan_tpu.core.defs import Cardinality, Multiplicity, SchemaStatus
 from titan_tpu.core.system_types import SystemTypes
-from titan_tpu.errors import SchemaViolationError
+from titan_tpu.errors import (SchemaNameExistsError,
+                              SchemaViolationError)
 from titan_tpu.ids import IDManager, IDType
 from titan_tpu.storage.api import Entry, KeySliceQuery, SliceQuery
 
 _NAME_INDEX_PREFIX = b"\x00sn\x00"   # system rows in graphindex
-_NAME_COLUMN = b"\x00"
 _INDEX_REGISTRY_KEY = b"\x00sidx\x00"   # column per index name -> schema id
 
 # dtype registry: stored code <-> python type (extend via register_dtype)
@@ -298,7 +298,32 @@ class SchemaManager:
                 if isinstance(sample_value, base):
                     dtype = base
                     break
-        return self.make_property_key(name, dtype)
+        return self._create_or_adopt(name, PropertyKey,
+                                     lambda: self.make_property_key(name, dtype))
+
+    def _create_or_adopt(self, name: str, kind: type, make):
+        """Auto-schema creation that survives a racing creator (another
+        thread or instance): if the create collides, adopt the winner.
+        (reference: DefaultSchemaMaker under concurrent tx / the
+        schema-broadcast path; collisions resolve via the claim columns in
+        _store_type.)
+
+        Known limit: if instance B writes DATA under its id in the window
+        before instance A's smaller claim lands, B's rows reference the
+        losing id (readable by id, orphaned from name lookups). The
+        reference closes this with consistent-key locks on schema creation;
+        production deployments should pre-create schema (auto_schema=False)
+        — same guidance as the reference."""
+        try:
+            st = make()
+        except SchemaNameExistsError:
+            # only the collision case — other schema errors propagate
+            self.expire(by_name=name)   # the peer's write made it stale
+            st = self.get_by_name(name)
+        if st is None or not isinstance(st, kind):
+            raise SchemaViolationError(
+                f"{name!r} exists but is not a {kind.__name__}")
+        return st
 
     def get_or_create_label(self, name: str) -> EdgeLabel:
         st = self.get_by_name(name)
@@ -308,7 +333,8 @@ class SchemaManager:
             return st
         if self._graph.auto_schema is False:
             raise SchemaViolationError(f"unknown edge label {name!r}")
-        return self.make_edge_label(name)
+        return self._create_or_adopt(name, EdgeLabel,
+                                     lambda: self.make_edge_label(name))
 
     def get_or_create_vertex_label(self, name: str) -> VertexLabel:
         st = self.get_by_name(name)
@@ -318,7 +344,8 @@ class SchemaManager:
             return st
         if self._graph.auto_schema is False:
             raise SchemaViolationError(f"unknown vertex label {name!r}")
-        return self.make_vertex_label(name)
+        return self._create_or_adopt(name, VertexLabel,
+                                     lambda: self.make_vertex_label(name))
 
     def update_type(self, st: SchemaType) -> SchemaType:
         """Rewrite a type's definition (index lifecycle transitions etc.)."""
@@ -419,18 +446,24 @@ class SchemaManager:
         try:
             for key, entries in backend.index_store.store.get_keys(
                     KeyRangeQuery(lo, hi, SliceQuery()), txh):
-                for e in entries:
-                    if e.column == _NAME_COLUMN:
-                        st = self.get_type(int.from_bytes(e.value, "big"))
-                        if st is not None:
-                            out.append(st)
+                if entries:
+                    # first claim column = smallest id = the winner
+                    # (legacy rows carry the id in the value instead)
+                    first = entries[0]
+                    sid = int.from_bytes(
+                        first.value if len(first.column) == 1
+                        else first.column, "big")
+                    st = self.get_type(sid)
+                    if st is not None:
+                        out.append(st)
         finally:
             txh.commit()
         return sorted(out, key=lambda t: t.id)
 
     def _store_type(self, st: SchemaType, expect_new: bool = True) -> SchemaType:
         if expect_new and self.get_by_name(st.name) is not None:
-            raise SchemaViolationError(f"schema name already exists: {st.name!r}")
+            raise SchemaNameExistsError(
+                f"schema name already exists: {st.name!r}")
         backend = self._graph.backend
         txh = backend.manager.begin_transaction()
         try:
@@ -443,13 +476,27 @@ class SchemaManager:
                 self._graph.id_assigner.next_relation_id(),
                 st.definition(), self)
             backend.edge_store.store.mutate(key, [name_entry, def_entry], [], txh)
+            # name-index entries are CLAIM COLUMNS keyed by the schema id;
+            # concurrent creators of the same name each write their own
+            # column and the smallest id deterministically wins (reference:
+            # the ConsistentKeyIDAuthority claim protocol shape) — no
+            # last-write-wins divergence between racing instances
             backend.index_store.store.mutate(
                 self._name_index_key(st.name),
-                [Entry(_NAME_COLUMN, st.id.to_bytes(8, "big"))], [], txh)
+                [Entry(st.id.to_bytes(8, "big"), b"")], [], txh)
             txh.commit()
         except BaseException:
             txh.rollback()
             raise
+        if expect_new:
+            # re-read: did a racing creator's smaller id win the name?
+            winner_id = self._load_name_index(st.name)
+            if winner_id is not None and winner_id != st.id:
+                winner = self.get_type(winner_id)
+                if winner is not None:
+                    with self._lock:
+                        self._by_name[st.name] = winner_id
+                    return winner
         with self._lock:
             self._by_id[st.id] = st
             self._by_name[st.name] = st.id
@@ -466,7 +513,25 @@ class SchemaManager:
             txh.commit()
         if not entries:
             return None
-        return int.from_bytes(entries[0].value, "big")
+        first = entries[0]
+        if len(first.column) == 1:
+            # legacy layout (pre-claim-column): fixed 1-byte column, id in
+            # the VALUE. It predates any claim, so it IS the winner; upgrade
+            # the row in place so future readers take the claim path.
+            legacy_id = int.from_bytes(first.value, "big")
+            try:
+                txh2 = backend.manager.begin_transaction()
+                backend.index_store.store.mutate(
+                    self._name_index_key(name),
+                    [Entry(legacy_id.to_bytes(8, "big"), b"")],
+                    [first.column], txh2)
+                txh2.commit()
+            except Exception:
+                pass   # reads still work off the legacy row
+            return legacy_id
+        # columns are big-endian id claims; ascending column order makes the
+        # first entry the smallest id — the deterministic winner
+        return int.from_bytes(first.column, "big")
 
     def _load_by_id(self, schema_id: int) -> Optional[SchemaType]:
         if not self.idm.is_schema_id(schema_id):
@@ -490,9 +555,15 @@ class SchemaManager:
             return None
         return _from_definition(schema_id, name, definition)
 
-    def expire(self, schema_id: Optional[int] = None) -> None:
+    def expire(self, schema_id: Optional[int] = None,
+               by_name: Optional[str] = None) -> None:
         with self._lock:
             self._index_ids = None
+            if by_name is not None:
+                sid = self._by_name.pop(by_name, None)
+                if sid is not None:
+                    self._by_id.pop(sid, None)
+                return
             if schema_id is None:
                 self._by_id.clear()
                 self._by_name.clear()

@@ -62,6 +62,11 @@ class SchemaViolationError(TitanError):
     """Operation violates a schema constraint (cardinality, multiplicity, ...)."""
 
 
+class SchemaNameExistsError(SchemaViolationError):
+    """A schema element with this name already exists (possibly created by
+    a racing transaction or peer instance)."""
+
+
 class QueryError(TitanError):
     """Malformed or unsupported query."""
 

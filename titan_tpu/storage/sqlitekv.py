@@ -54,55 +54,69 @@ def _wrap_sqlite_errors(fn):
 
 
 class SqliteTransaction(StoreTransaction):
+    """Split-connection transaction: reads run on a deferred-snapshot
+    connection, writes on a separate BEGIN IMMEDIATE connection opened at
+    the first write.
+
+    Why: sqlite (WAL) refuses to upgrade a deferred read snapshot to a
+    write lock once ANY other connection has committed — SQLITE_BUSY with
+    no busy-wait, unrecoverable without restarting the whole tx. The graph
+    engine's transactions are exactly that shape (read phase, then one
+    batched mutation flush at commit), so under ANY concurrency (a peer
+    instance, an id-block renewal) single-connection txs livelock. With
+    the split: reads keep one consistent snapshot; the write connection
+    takes the lock up front with proper 30s busy-waiting and commits the
+    whole batch atomically. Write-then-read within ONE store tx loses
+    read-your-writes — no internal caller does that (the graph buffers all
+    mutations until commit; id-authority/locking/log use one tx per op).
+    """
+
     def __init__(self, manager: "SqliteStoreManager",
                  config: Optional[TransactionHandleConfig] = None):
         super().__init__(config)
         self._manager = manager
-        self._conn: Optional[sqlite3.Connection] = None
-        self._ensured: set[str] = set()
+        self._read_conn: Optional[sqlite3.Connection] = None
+        self._write_conn: Optional[sqlite3.Connection] = None
         self._lock = threading.Lock()
         self.closed = False
 
     def connection(self, write: bool = False) -> sqlite3.Connection:
-        # write txs take the write lock UP FRONT (BEGIN IMMEDIATE): a
-        # deferred tx that upgrades read→write mid-flight gets SQLITE_BUSY
-        # *immediately* (no busy-wait) when another process holds the lock —
-        # fatal for multi-process scan/reindex workers. Read-first txs stay
-        # deferred so concurrent WAL readers never serialize. NOTE: the flag
-        # only matters on the FIRST call — a tx already opened deferred by a
-        # read cannot upgrade its BEGIN; such read-then-write txs keep the
-        # upgrade risk and rely on caller-level retries (the split runners
-        # retry idempotent work; the graph commit path retries via
-        # BackendOperation).
         with self._lock:
             if self.closed:
                 raise PermanentBackendError("transaction already closed")
-            if self._conn is None:
-                self._conn = self._manager._new_connection()
-                self._conn.execute("BEGIN IMMEDIATE" if write else "BEGIN")
-            return self._conn
-
-    def ensure_table(self, table: str, create_sql: str) -> None:
-        """Transactional DDL: tables must be created through THIS connection
-        while it holds the write lock, or shared-connection DDL deadlocks."""
-        conn = self.connection()
-        if table not in self._ensured:
-            conn.execute(create_sql)
-            self._ensured.add(table)
+            if write:
+                if self._write_conn is None:
+                    conn = self._manager._new_connection()
+                    try:
+                        conn.execute("BEGIN IMMEDIATE")
+                    except sqlite3.OperationalError as e:
+                        conn.close()
+                        raise TemporaryBackendError(str(e)) from e
+                    self._write_conn = conn
+                return self._write_conn
+            if self._read_conn is None:
+                self._read_conn = self._manager._new_connection()
+                self._read_conn.execute("BEGIN")
+            return self._read_conn
 
     def commit(self) -> None:
         with self._lock:
             if self.closed:
                 return
-            if self._conn is not None:
+            if self._write_conn is not None:
                 try:
-                    self._conn.commit()
+                    self._write_conn.commit()
                 except sqlite3.OperationalError as e:
-                    # leave the tx OPEN so a retry actually re-commits instead
-                    # of hitting the closed-tx early exit and faking success
+                    # leave the tx OPEN so a retry actually re-commits
+                    # instead of hitting the closed-tx early exit and
+                    # faking success
                     raise TemporaryBackendError(str(e)) from e
-                self._conn.close()
-                self._conn = None
+                self._write_conn.close()
+                self._write_conn = None
+            if self._read_conn is not None:
+                self._read_conn.rollback()   # just releases the snapshot
+                self._read_conn.close()
+                self._read_conn = None
             self.closed = True
 
     def rollback(self) -> None:
@@ -110,10 +124,12 @@ class SqliteTransaction(StoreTransaction):
             if self.closed:
                 return
             self.closed = True
-            if self._conn is not None:
-                self._conn.rollback()
-                self._conn.close()
-                self._conn = None
+            for conn in (self._write_conn, self._read_conn):
+                if conn is not None:
+                    conn.rollback()
+                    conn.close()
+            self._write_conn = None
+            self._read_conn = None
 
 
 class SqliteStore(KeyColumnValueStore):
@@ -126,21 +142,11 @@ class SqliteStore(KeyColumnValueStore):
     def name(self) -> str:
         return self._name
 
-    @property
-    def _create_sql(self) -> str:
-        return (f"CREATE TABLE IF NOT EXISTS {self._table} "
-                f"(k BLOB NOT NULL, c BLOB NOT NULL, v BLOB NOT NULL, "
-                f"e REAL, "
-                f"PRIMARY KEY (k, c)) WITHOUT ROWID")
-
     def _ensure(self, txh: StoreTransaction) -> None:
-        # migration first: it ALTERs via the shared connection, and must land
-        # before the tx connection opens its read snapshot in ensure_table
+        # migration first: it ALTERs via the shared connection, and must
+        # land before any tx connection snapshots the schema
         self._manager._migrate_ttl_column(self._table)
-        if isinstance(txh, SqliteTransaction):
-            txh.ensure_table(self._table, self._create_sql)
-        else:
-            self._manager._ensure_table(self._table)
+        self._manager._ensure_table(self._table)
 
     @_wrap_sqlite_errors
     def _execute(self, txh: StoreTransaction, sql: str, params=()) -> list:
@@ -206,18 +212,12 @@ class SqliteStore(KeyColumnValueStore):
             ttl = entry_ttl(e)
             return (key, e.column, e.value, now + ttl if ttl > 0 else None)
 
+        self._ensure(txh)
         if isinstance(txh, SqliteTransaction):
-            # the write connection must be requested BEFORE ensure_table
-            # opens it deferred, or BEGIN IMMEDIATE never happens and the
-            # tx upgrades read→write (immediate SQLITE_BUSY under
-            # multi-process contention)
-            self._manager._migrate_ttl_column(self._table)
             conn = txh.connection(write=True)
-            txh.ensure_table(self._table, self._create_sql)
             conn.executemany(del_sql, [(key, c) for c in deletions])
             conn.executemany(add_sql, [row(e) for e in additions])
         else:
-            self._ensure(txh)
             self._manager._shared_executemany(
                 [(del_sql, [(key, c) for c in deletions]),
                  (add_sql, [row(e) for e in additions])])
@@ -363,6 +363,10 @@ class SqliteStoreManager(KeyColumnValueStoreManager):
         store = self._stores.get(name)
         if store is None:
             store = SqliteStore(self, name)
+            # eager DDL: a table created mid-transaction would be invisible
+            # to read snapshots that began earlier
+            self._migrate_ttl_column(store._table)
+            self._ensure_table(store._table)
             self._stores[name] = store
         return store
 
@@ -371,6 +375,12 @@ class SqliteStoreManager(KeyColumnValueStoreManager):
         return SqliteTransaction(self, config)
 
     def mutate_many(self, mutations: dict, txh: StoreTransaction) -> None:
+        # ensure EVERY table before the first write: DDL runs on the shared
+        # connection, which would deadlock against this tx's own write lock
+        # if attempted after a previous store's mutate opened it
+        # (open_database runs the migrate+create eagerly on first open)
+        for store_name in mutations:
+            self.open_database(store_name)
         if isinstance(txh, SqliteTransaction):
             for store_name, by_key in mutations.items():
                 store = self.open_database(store_name)
@@ -407,13 +417,16 @@ class SqliteStoreManager(KeyColumnValueStoreManager):
             shutil.rmtree(self._tmpdir, ignore_errors=True)
 
     def clear_storage(self) -> None:
+        # DELETE, not DROP: later transactions assume pre-created tables
+        # (re-creating one mid-write-tx would deadlock shared-conn DDL
+        # against the tx's own write lock)
         with self._shared_lock:
             tables = [r[0] for r in self._shared.execute(
                 "SELECT name FROM sqlite_master WHERE type='table' AND "
                 "name LIKE 'kcvs_%'").fetchall()]
             for table in tables:
-                self._shared.execute(f"DROP TABLE IF EXISTS {table}")
-            self._tables.clear()
+                self._shared.execute(f"DELETE FROM {table}")
+            self._shared.commit()
             self._stores.clear()
 
     def exists(self) -> bool:
