@@ -3,20 +3,53 @@
 (reference: titan-core titan/example/GraphOfTheGodsFactory.java:26,52 — same
 schema and data: 12 vertices (titan/god/demigod/human/monster/location),
 17 edges (father/mother/brother/battled/lives/pet) with battled sort-keyed
-by time and lives carrying a reason property.)
+by time and carrying a Geoshape battle place, lives carrying a reason, a
+composite (optionally unique) name index, and optional mixed indexes on
+vertex age and edge reason+place.)
 """
 
 from __future__ import annotations
 
+from titan_tpu.core.attribute import Geoshape
 from titan_tpu.core.defs import Cardinality, Multiplicity
 
 
-def load(graph, batch: bool = False):
+def load(graph, batch: bool = False, mixed_index_name=None,
+         unique_name_index: bool = False):
     schema = graph.schema
-    name = schema.get_by_name("name") or schema.make_property_key("name", str)
-    age = schema.get_by_name("age") or schema.make_property_key("age", int)
-    time = schema.get_by_name("time") or schema.make_property_key("time", int)
-    reason = schema.get_by_name("reason") or schema.make_property_key("reason", str)
+    mgmt = graph.management()
+    name = schema.get_by_name("name") or mgmt.make_property_key("name", str)
+    age = schema.get_by_name("age") or mgmt.make_property_key("age", int)
+    time = schema.get_by_name("time") or mgmt.make_property_key("time", int)
+    reason = schema.get_by_name("reason") or mgmt.make_property_key(
+        "reason", str)
+    place = schema.get_by_name("place") or mgmt.make_property_key(
+        "place", Geoshape)
+
+    def activate(idx_name):
+        # indexes over PRE-EXISTING keys start INSTALLED; walk them through
+        # REGISTER -> REINDEX (which enables) so they actually serve queries
+        # and enforce uniqueness (reference: SchemaAction lifecycle)
+        idx = mgmt.get_graph_index(idx_name)
+        if idx is not None and not idx.queryable:
+            mgmt.update_index(idx_name, "register")
+            mgmt.update_index(idx_name, "reindex")
+
+    if schema.get_by_name("name_idx") is None:
+        b = mgmt.build_index("name_idx", "vertex").add_key(name)
+        if unique_name_index:
+            b.unique()
+        b.build_composite_index()
+        activate("name_idx")
+    if mixed_index_name and schema.get_by_name("vertices") is None:
+        mgmt.build_index("vertices", "vertex").add_key(age) \
+            .build_mixed_index(mixed_index_name)
+        activate("vertices")
+    if mixed_index_name and schema.get_by_name("edges") is None:
+        mgmt.build_index("edges", "edge").add_key(reason).add_key(place) \
+            .build_mixed_index(mixed_index_name)
+        activate("edges")
+    mgmt.commit()
 
     schema.get_by_name("father") or schema.make_edge_label(
         "father", Multiplicity.MANY2ONE)
@@ -56,9 +89,12 @@ def load(graph, batch: bool = False):
     neptune.add_edge("brother", pluto)
     hercules.add_edge("father", jupiter)
     hercules.add_edge("mother", alcmene)
-    hercules.add_edge("battled", nemean, time=1)
-    hercules.add_edge("battled", hydra, time=2)
-    hercules.add_edge("battled", cerberus, time=12)
+    hercules.add_edge("battled", nemean, time=1,
+                      place=Geoshape.point(38.1, 23.7))
+    hercules.add_edge("battled", hydra, time=2,
+                      place=Geoshape.point(37.7, 23.9))
+    hercules.add_edge("battled", cerberus, time=12,
+                      place=Geoshape.point(39.0, 22.0))
     pluto.add_edge("brother", jupiter)
     pluto.add_edge("brother", neptune)
     pluto.add_edge("lives", tartarus, reason="no fear of death")
