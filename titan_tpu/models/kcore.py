@@ -1,0 +1,48 @@
+"""k-core membership as a DenseProgram.
+
+Parity role: part of the OLAP model zoo (the reference ships vertex-program
+fixtures — PageRank, ShortestDistance — and any TinkerPop VertexProgram;
+k-core is the canonical iterative-peeling program). A vertex stays in the
+k-core while it has >= k neighbors that are themselves still in: each
+superstep sums alive in-neighbors and peels below-threshold vertices until
+a fixed point (runs on the symmetrized snapshot for undirected semantics).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from titan_tpu.olap.api import DenseProgram
+
+
+class KCore(DenseProgram):
+    combine = "sum"
+
+    def __init__(self, k: int, max_iterations: int = 1000):
+        self.k = k
+        self.max_iterations = max_iterations
+
+    def init(self, n, params):
+        return {"alive": jnp.ones((n,), jnp.float32)}
+
+    def message(self, src_state, edge_data, params):
+        return src_state["alive"]
+
+    def apply(self, state, agg, iteration, params):
+        # peel: stay alive only with >= k alive neighbors
+        return {"alive": (state["alive"] > 0) * (agg >= self.k)
+                .astype(jnp.float32)}
+
+    def done(self, state, new_state, agg, iteration, params):
+        return jnp.all(new_state["alive"] == state["alive"])
+
+    def outputs(self, state, params):
+        return {"in_core": state["alive"] > 0}
+
+
+def run(computer, k: int, snapshot=None, max_iterations: int = 1000):
+    # k-core is an undirected notion: the default snapshot must be the
+    # symmetrized graph (same as WCC)
+    snap = snapshot or computer.snapshot(directed=False)
+    prog = KCore(k, max_iterations)
+    return computer.run(prog, snapshot=snap)

@@ -26,6 +26,20 @@ def vertex_mesh(num_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs[:num_devices]), (VERTEX_AXIS,))
 
 
+def global_sum(x):
+    """Sum across the FULL vertex axis from inside a DenseProgram callback:
+    shard-local sum + psum over the mesh when executing under shard_map,
+    plain sum on a single device (the axis isn't bound there). Programs
+    with global reductions (e.g. HITS normalization) must use this instead
+    of jnp.sum, or sharded runs silently normalize per shard."""
+    import jax.numpy as jnp
+    total = jnp.sum(x)
+    try:
+        return jax.lax.psum(total, VERTEX_AXIS)
+    except NameError:
+        return total
+
+
 def state_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(VERTEX_AXIS))
 
