@@ -42,17 +42,27 @@ def main() -> None:
     deg = snap.out_degree
     source = int(np.flatnonzero(deg > 0)[0])
 
-    # frontier-sparse BFS (O(E) total work; see PERF_NOTES.md)
+    # frontier-sparse BFS (O(E) total work; see PERF_NOTES.md); sharded
+    # over all chips when more than one is attached
+    ndev = jax.device_count()
+    if ndev > 1:
+        from titan_tpu.models.bfs import frontier_bfs_sharded
+        from titan_tpu.parallel.mesh import vertex_mesh
+        mesh = vertex_mesh(ndev)
+        run_bfs = lambda: frontier_bfs_sharded(snap, source, mesh)  # noqa: E731
+    else:
+        run_bfs = lambda: frontier_bfs(snap, source)  # noqa: E731
+
     # warm-up / compile + converged run
     t1 = time.time()
-    dist, iters = frontier_bfs(snap, source)
+    dist, iters = run_bfs()
     first_s = time.time() - t1
 
     # timed runs (compile cached)
     times = []
     for _ in range(3):
         t2 = time.time()
-        dist, iters = frontier_bfs(snap, source)
+        dist, iters = run_bfs()
         times.append(time.time() - t2)
     t_bfs = min(times)
 

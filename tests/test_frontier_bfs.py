@@ -63,6 +63,36 @@ def test_chain_graph_many_levels():
     assert levels == n - 1
 
 
+@pytest.mark.parametrize("seed", [0, 5])
+def test_sharded_matches_single_chip(seed):
+    from titan_tpu.models.bfs import frontier_bfs_sharded
+    from titan_tpu.parallel.mesh import vertex_mesh
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(50, 800))
+    e = int(rng.integers(10, n * 6))
+    src = rng.integers(0, n, e).astype(np.int32)
+    dst = rng.integers(0, n, e).astype(np.int32)
+    snap = snap_mod.from_arrays(n, src, dst)
+    s0 = int(rng.integers(0, n))
+    d_single, _ = frontier_bfs(snap, s0)
+    d_sharded, _ = frontier_bfs_sharded(snap, s0, vertex_mesh(8))
+    assert np.array_equal(d_single, d_sharded)
+    assert np.array_equal(np.where(d_sharded >= (1 << 30), 1 << 30,
+                                   d_sharded), np_bfs(n, src, dst, s0))
+
+
+def test_sharded_chain():
+    from titan_tpu.models.bfs import frontier_bfs_sharded
+    from titan_tpu.parallel.mesh import vertex_mesh
+    n = 100
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    snap = snap_mod.from_arrays(n, src, dst)
+    dist, levels = frontier_bfs_sharded(snap, 0, vertex_mesh(8))
+    assert np.array_equal(dist, np.arange(n))
+    assert levels == n - 1
+
+
 def test_matches_dense_program():
     from titan_tpu.olap.tpu.engine import TPUGraphComputer
     from titan_tpu.models.bfs import BFS
