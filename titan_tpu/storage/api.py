@@ -97,10 +97,11 @@ class KeySliceQuery:
 
 @dataclass(frozen=True)
 class KeyRangeQuery:
-    """Key interval [key_start, key_end) × column slice, for ordered scans
+    """Key interval [key_start, key_end) × column slice, for ordered scans;
+    ``key_end=None`` means unbounded above
     (reference: keycolumnvalue/KeyRangeQuery.java)."""
     key_start: bytes
-    key_end: bytes
+    key_end: Optional[bytes]
     slice: SliceQuery
     key_limit: Optional[int] = None
 

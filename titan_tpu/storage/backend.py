@@ -45,7 +45,9 @@ class Backend:
             manager = store_manager(
                 backend_name,
                 directory=config.get(d.STORAGE_DIRECTORY),
-                read_only=config.get(d.STORAGE_READONLY))
+                read_only=config.get(d.STORAGE_READONLY),
+                hostname=config.get(d.STORAGE_HOSTNAME),
+                port=config.get(d.STORAGE_PORT))
         # metrics wrapping sits directly over the raw manager so every opened
         # store is instrumented, and the expiration cache layers ABOVE it —
         # cache hits don't count as backend ops (reference: Backend.java:142-146)

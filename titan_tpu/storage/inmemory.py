@@ -134,7 +134,8 @@ class InMemoryStore(KeyColumnValueStore):
             keys = self._sorted_keys
             if isinstance(query, KeyRangeQuery):
                 lo = bisect.bisect_left(keys, query.key_start)
-                hi = bisect.bisect_left(keys, query.key_end)
+                hi = bisect.bisect_left(keys, query.key_end) \
+                    if query.key_end is not None else len(keys)
                 keys = keys[lo:hi]
                 key_limit = query.key_limit
                 sl = query.slice

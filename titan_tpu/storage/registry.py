@@ -23,7 +23,17 @@ def store_manager(shorthand: str, **kwargs):
         return factory(**kwargs)
     if "." in shorthand:  # import path "pkg.mod.Class"
         mod, _, cls = shorthand.rpartition(".")
-        return getattr(importlib.import_module(mod), cls)(**kwargs)
+        ctor = getattr(importlib.import_module(mod), cls)
+        # plugins only receive the kwargs their constructor declares (the
+        # Backend passes the full connection set: directory/hostname/...)
+        import inspect
+        sig = inspect.signature(ctor.__init__ if inspect.isclass(ctor)
+                                else ctor)
+        params = sig.parameters.values()
+        if not any(p.kind is inspect.Parameter.VAR_KEYWORD for p in params):
+            accepted = {p.name for p in params}
+            kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+        return ctor(**kwargs)
     raise ValueError(f"unknown storage backend {shorthand!r}; known: "
                      f"{sorted(_STORE_FACTORIES)}")
 
@@ -38,5 +48,15 @@ def _sqlite(directory=None, read_only=False, **kw):
     return SqliteStoreManager(directory, read_only)
 
 
+def _remote(hostname=None, port=None, **kw):
+    from titan_tpu.storage.remote import RemoteStoreManager
+    # storage.hostname is a host LIST (reference parity); this adapter
+    # currently targets one storage node
+    if isinstance(hostname, (list, tuple)):
+        hostname = hostname[0] if hostname else None
+    return RemoteStoreManager(hostname or "127.0.0.1", int(port or 8283))
+
+
 register_store("inmemory", _inmemory)
 register_store("sqlite", _sqlite)
+register_store("remote", _remote)
