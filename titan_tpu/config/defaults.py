@@ -153,6 +153,8 @@ INDEX_DIRECTORY = ConfigOption(INDEX_NS, "directory", "index data directory",
                                str, None, Mutability.MASKABLE)
 INDEX_HOSTNAME = ConfigOption(INDEX_NS, "hostname", "index hosts", list, [],
                               Mutability.MASKABLE)
+INDEX_PORT = ConfigOption(INDEX_NS, "port", "index node port", int, None,
+                          Mutability.MASKABLE)
 INDEX_MAX_RESULT_SET = ConfigOption(
     INDEX_NS, "max-result-set-size", "cap on index result sets", int, 100_000,
     Mutability.MASKABLE, positive)

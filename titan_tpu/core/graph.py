@@ -101,6 +101,13 @@ class StandardGraph:
             # embedded persistent full-text engine (the Lucene-role provider)
             from titan_tpu.indexing.ftsindex import FTSIndex
             provider = FTSIndex(name, directory or None)
+        elif backend == "remote-index":
+            # networked index node (the ES/Solr role)
+            from titan_tpu.indexing.remote import RemoteIndexProvider
+            hosts = self.config.get(d.INDEX_HOSTNAME, name) or []
+            provider = RemoteIndexProvider(
+                name, hostname=hosts[0] if hosts else "127.0.0.1",
+                port=self.config.get(d.INDEX_PORT, name) or 8284)
         elif backend in ("memindex", "elasticsearch", "solr"):
             # in-process provider; real cluster providers plug in via
             # import path

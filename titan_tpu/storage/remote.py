@@ -25,13 +25,10 @@ the server materializing the store. TTLs travel with each entry.
 from __future__ import annotations
 
 import base64
-import json
-import threading
-import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterator, Optional, Sequence
 
-from titan_tpu.errors import PermanentBackendError, TemporaryBackendError
+from titan_tpu.errors import PermanentBackendError
+from titan_tpu.utils.httpnode import JsonNode, json_call, run_node_cli
 from titan_tpu.storage.api import (Entry, EntryList, KCVMutation,
                                    KeyColumnValueStore,
                                    KeyColumnValueStoreManager, KeyRangeQuery,
@@ -69,131 +66,82 @@ def _dec_slice(d: dict) -> SliceQuery:
                       d.get("limit"))
 
 
-class KCVSServer:
+class KCVSServer(JsonNode):
     """Hosts a local store manager as a storage node."""
 
     def __init__(self, manager: KeyColumnValueStoreManager,
                  host: str = "127.0.0.1", port: int = 0):
+        super().__init__(self._dispatch, host, port, name="kcvs-node")
         self.manager = manager
-        self.host = host
-        self.port = port
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
 
-    def start(self) -> "KCVSServer":
-        server = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _send(self, code: int, payload) -> None:
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_POST(self):
-                length = int(self.headers.get("Content-Length", 0))
+    def _dispatch(self, path: str, req: dict):
+        mgr = self.manager
+        txh = mgr.begin_transaction()
+        try:
+            if path == "/slice":
+                store = mgr.open_database(req["store"])
+                entries = store.get_slice(
+                    KeySliceQuery(_ub(req["key"]),
+                                  _dec_slice(req["slice"])), txh)
+                return {"entries": [[_b(e.column), _b(e.value)]
+                                    for e in entries]}
+            if path == "/slice_multi":
+                store = mgr.open_database(req["store"])
+                res = store.get_slice_multi(
+                    [_ub(k) for k in req["keys"]],
+                    _dec_slice(req["slice"]), txh)
+                return {"rows": [[_b(k), [[_b(e.column), _b(e.value)]
+                                          for e in v]]
+                                 for k, v in res.items()]}
+            if path == "/mutate_many":
+                muts = {}
+                for store_name, by_key in req["mutations"].items():
+                    m = muts.setdefault(store_name, {})
+                    for k, (adds, dels) in by_key.items():
+                        m[_ub(k)] = KCVMutation(
+                            [_dec_entry(a) for a in adds],
+                            [_ub(c) for c in dels])
                 try:
-                    req = json.loads(self.rfile.read(length) or b"{}")
-                    result = self._dispatch(self.path, req)
-                except TemporaryBackendError as e:
-                    self._send(503, {"error": str(e)})
-                    return
-                except Exception as e:   # noqa: BLE001 — wire boundary
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
-                    return
-                self._send(200, result)
-
-            def _dispatch(self, path: str, req: dict):
-                mgr = server.manager
-                txh = mgr.begin_transaction()
-                try:
-                    if path == "/slice":
-                        store = mgr.open_database(req["store"])
-                        entries = store.get_slice(
-                            KeySliceQuery(_ub(req["key"]),
-                                          _dec_slice(req["slice"])), txh)
-                        return {"entries": [[_b(e.column), _b(e.value)]
-                                            for e in entries]}
-                    if path == "/slice_multi":
-                        store = mgr.open_database(req["store"])
-                        res = store.get_slice_multi(
-                            [_ub(k) for k in req["keys"]],
-                            _dec_slice(req["slice"]), txh)
-                        return {"rows": [[_b(k), [[_b(e.column), _b(e.value)]
-                                                  for e in v]]
-                                         for k, v in res.items()]}
-                    if path == "/mutate_many":
-                        muts = {}
-                        for store_name, by_key in req["mutations"].items():
-                            m = muts.setdefault(store_name, {})
-                            for k, (adds, dels) in by_key.items():
-                                m[_ub(k)] = KCVMutation(
-                                    [_dec_entry(a) for a in adds],
-                                    [_ub(c) for c in dels])
-                        try:
-                            mgr.mutate_many(muts, txh)
-                            txh.commit()
-                        except BaseException:
-                            # an abandoned write tx would pin the node's
-                            # write lock until GC
-                            txh.rollback()
-                            raise
-                        return {"ok": True}
-                    if path == "/scan_page":
-                        store = mgr.open_database(req["store"])
-                        sl = _dec_slice(req["slice"])
-                        after = _ub(req.get("after"))
-                        lo = _ub(req.get("key_start")) or b""
-                        hi = _ub(req.get("key_end"))   # None = unbounded
-                        if after is not None and after >= lo:
-                            lo = after + b"\x00"
-                        q = KeyRangeQuery(lo, hi, sl)
-                        rows = []
-                        for key, entries in store.get_keys(q, txh):
-                            rows.append([_b(key), [[_b(e.column), _b(e.value)]
-                                                   for e in entries]])
-                            if len(rows) >= _SCAN_PAGE:
-                                break
-                        return {"rows": rows,
-                                "done": len(rows) < _SCAN_PAGE}
-                    if path == "/admin":
-                        op = req["op"]
-                        if op == "clear":
-                            mgr.clear_storage()
-                            return {"ok": True}
-                        if op == "exists":
-                            return {"exists": mgr.exists()}
-                        if op == "features":
-                            f = mgr.features
-                            return {"cell_ttl": f.cell_ttl}
-                        raise PermanentBackendError(f"unknown admin op {op!r}")
-                    raise PermanentBackendError(f"unknown endpoint {path!r}")
-                finally:
-                    if path != "/mutate_many":
-                        txh.commit()
-
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True, name="kcvs-server")
-        self._thread.start()
-        return self
-
-    @property
-    def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
-
-    def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-
+                    mgr.mutate_many(muts, txh)
+                    txh.commit()
+                except BaseException:
+                    # an abandoned write tx would pin the node's
+                    # write lock until GC
+                    txh.rollback()
+                    raise
+                return {"ok": True}
+            if path == "/scan_page":
+                store = mgr.open_database(req["store"])
+                sl = _dec_slice(req["slice"])
+                after = _ub(req.get("after"))
+                lo = _ub(req.get("key_start")) or b""
+                hi = _ub(req.get("key_end"))   # None = unbounded
+                if after is not None and after >= lo:
+                    lo = after + b"\x00"
+                q = KeyRangeQuery(lo, hi, sl)
+                rows = []
+                for key, entries in store.get_keys(q, txh):
+                    rows.append([_b(key), [[_b(e.column), _b(e.value)]
+                                           for e in entries]])
+                    if len(rows) >= _SCAN_PAGE:
+                        break
+                return {"rows": rows,
+                        "done": len(rows) < _SCAN_PAGE}
+            if path == "/admin":
+                op = req["op"]
+                if op == "clear":
+                    mgr.clear_storage()
+                    return {"ok": True}
+                if op == "exists":
+                    return {"exists": mgr.exists()}
+                if op == "features":
+                    f = mgr.features
+                    return {"cell_ttl": f.cell_ttl}
+                raise PermanentBackendError(f"unknown admin op {op!r}")
+            raise PermanentBackendError(f"unknown endpoint {path!r}")
+        finally:
+            if path != "/mutate_many":
+                txh.commit()
 
 # ---------------------------------------------------------------------------
 # client side
@@ -267,26 +215,7 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
         self._cell_ttl = bool(feats.get("cell_ttl"))
 
     def _call(self, path: str, payload: dict) -> dict:
-        req = urllib.request.Request(
-            self._url + path, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
-        try:
-            with urllib.request.urlopen(req, timeout=self._timeout) as r:
-                return json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            body = {}
-            try:
-                body = json.loads(e.read())
-            except Exception:   # noqa: BLE001
-                pass
-            msg = body.get("error", str(e))
-            if e.code == 503:
-                raise TemporaryBackendError(msg) from e
-            raise PermanentBackendError(msg) from e
-        except (urllib.error.URLError, OSError) as e:
-            # connection failures are retryable (reference: thrift pool
-            # rebuild + BackendOperation retries)
-            raise TemporaryBackendError(str(e)) from e
+        return json_call(self._url, path, payload, timeout=self._timeout)
 
     @property
     def name(self) -> str:
@@ -334,24 +263,15 @@ class RemoteStoreManager(KeyColumnValueStoreManager):
 
 
 def main(argv: Optional[list] = None) -> None:
-    """``python -m titan_tpu.storage.remote /data/dir [port]`` — run a
-    storage node (sqlite-backed) that remote graph instances mount with
-    ``storage.backend=remote``."""
-    import sys
-    args = list(sys.argv[1:] if argv is None else argv)
-    if not args:
-        print("usage: python -m titan_tpu.storage.remote <data-dir> [port]",
-              file=sys.stderr)
-        raise SystemExit(2)
-    from titan_tpu.storage.sqlitekv import SqliteStoreManager
-    manager = SqliteStoreManager(args[0])
-    port = int(args[1]) if len(args) > 1 else 8283
-    server = KCVSServer(manager, port=port).start()
-    print(f"kcvs storage node serving {args[0]} on {server.url}")
-    try:
-        threading.Event().wait()
-    except KeyboardInterrupt:
-        server.stop()
+    """``python -m titan_tpu.storage.remote <data-dir> [port] [host]`` —
+    run a storage node (sqlite-backed, binds 0.0.0.0 by default so remote
+    graph instances can reach it) mounted with ``storage.backend=remote``."""
+    def make(directory, host, port):
+        from titan_tpu.storage.sqlitekv import SqliteStoreManager
+        return KCVSServer(SqliteStoreManager(directory), host=host,
+                          port=port or 8283)
+    run_node_cli(argv, "usage: python -m titan_tpu.storage.remote "
+                       "<data-dir> [port] [host]", make)
 
 
 if __name__ == "__main__":

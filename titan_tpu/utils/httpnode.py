@@ -1,0 +1,117 @@
+"""Shared JSON-over-HTTP plumbing for the network nodes (storage + index).
+
+One server shell and one client call so the error taxonomy stays aligned
+on both wires: server-side TemporaryBackendError → HTTP 503 → client
+TemporaryBackendError (retryable by the backend-op layer); anything else →
+500 → PermanentBackendError; connection failures → TemporaryBackendError.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from titan_tpu.errors import PermanentBackendError, TemporaryBackendError
+
+
+class JsonNode:
+    """HTTP server shell around a ``dispatch(path, request_dict)`` callable."""
+
+    def __init__(self, dispatch: Callable[[str, dict], dict],
+                 host: str = "127.0.0.1", port: int = 0,
+                 name: str = "node"):
+        self._dispatch = dispatch
+        self.host = host
+        self.port = port
+        self._name = name
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def start(self) -> "JsonNode":
+        node = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    result = node._dispatch(self.path, req)
+                except TemporaryBackendError as e:
+                    self._send(503, {"error": str(e)})
+                    return
+                except Exception as e:   # noqa: BLE001 — wire boundary
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._send(200, result)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name=self._name).start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def json_call(url: str, path: str, payload: dict,
+              timeout: float = 30.0) -> dict:
+    """Client half: POST + error-taxonomy mapping."""
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.read()).get("error", str(e))
+        except Exception:   # noqa: BLE001
+            msg = str(e)
+        if e.code == 503:
+            raise TemporaryBackendError(msg) from e
+        raise PermanentBackendError(msg) from e
+    except (urllib.error.URLError, OSError) as e:
+        # connection failures are retryable (reference: thrift pool
+        # rebuild + BackendOperation retries)
+        raise TemporaryBackendError(str(e)) from e
+
+
+def run_node_cli(argv, usage: str, make_node: Callable[[str, str, int],
+                                                       JsonNode]) -> None:
+    """Shared ``python -m …`` entry: <data-dir> [port] [host]. Binds
+    0.0.0.0 by default so remote graph instances can actually reach the
+    node."""
+    import sys
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        print(usage, file=sys.stderr)
+        raise SystemExit(2)
+    port = int(args[1]) if len(args) > 1 else 0
+    host = args[2] if len(args) > 2 else "0.0.0.0"
+    node = make_node(args[0], host, port).start()
+    print(f"{node._name} serving on {node.url}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        node.stop()
