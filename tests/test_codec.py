@@ -22,16 +22,65 @@ IDM = IDManager(partition_bits=4)
 # attributes
 # ---------------------------------------------------------------------------
 
+import decimal
+
 VALUES = [True, False, 0, 1, -1, 2**40, -(2**40), 3.14159, -2.5e-300, "héllo",
           "", "a\x00b", b"", b"\x00\xff\x00", uuid.uuid4(),
           dt.datetime(2026, 7, 29, tzinfo=dt.timezone.utc),
-          [1, "two", 3.0], {"k": [1, 2], 3: None}, None]
+          [1, "two", 3.0], {"k": [1, 2], 3: None}, None,
+          decimal.Decimal("123.456789012345678901234567890"),
+          dt.date(1969, 7, 20), dt.time(13, 37, 59, 123456),
+          dt.timedelta(days=-3, seconds=7, microseconds=13),
+          (1, "two", 3.0), {1, "a"}, frozenset({2.5, "b"})]
 
 
 def test_self_describing_roundtrip():
     for v in VALUES:
         got = S.value_from_bytes(S.value_bytes(v))
         assert got == v and type(got) is type(v)
+
+
+def test_new_dtypes_as_graph_properties():
+    import titan_tpu
+    g = titan_tpu.open("inmemory")
+    tx = g.new_transaction()
+    v = tx.add_vertex("order",
+                      total=decimal.Decimal("19.99"),
+                      placed=dt.date(2026, 7, 30),
+                      eta=dt.timedelta(days=2),
+                      tags=frozenset({"rush", "gift"}))
+    vid = v.id
+    tx.commit()
+    tx2 = g.new_transaction()
+    got = tx2.vertex(vid)
+    assert got.value("total") == decimal.Decimal("19.99")
+    assert got.value("placed") == dt.date(2026, 7, 30)
+    assert got.value("eta") == dt.timedelta(days=2)
+    assert got.value("tags") == frozenset({"rush", "gift"})
+    tx2.rollback()
+    g.close()
+
+
+def test_date_rejects_datetime_and_timedelta_rejects_overflow():
+    import pytest
+    with pytest.raises(TypeError):
+        S.ordered_bytes(dt.datetime(2026, 7, 30, 12, 0), dt.date)
+    with pytest.raises(ValueError):
+        S.ordered_bytes(dt.timedelta(days=200_000_000), dt.timedelta)
+    with pytest.raises(ValueError):
+        S.value_bytes(dt.timedelta(days=200_000_000))
+
+
+def test_ordered_date_and_timedelta():
+    dates = [dt.date(1, 1, 1), dt.date(1969, 7, 20), dt.date(2026, 7, 30),
+             dt.date(9999, 12, 31)]
+    deltas = [dt.timedelta(days=-5), dt.timedelta(0),
+              dt.timedelta(microseconds=1), dt.timedelta(days=400)]
+    for vals, t in [(dates, dt.date), (deltas, dt.timedelta)]:
+        enc = sorted((S.ordered_bytes(v, t), v) for v in vals)
+        assert [v for _, v in enc] == sorted(vals)
+        for b, v in enc:
+            assert S.read_ordered(ReadBuffer(b), t) == v
 
 
 def test_ordered_roundtrip_and_order():

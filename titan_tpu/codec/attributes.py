@@ -225,6 +225,58 @@ class Serializer:
                                        lambda o, v: None, lambda b: None))
         from titan_tpu.core.attribute import Geoshape
         self.register(AttributeHandler(11, Geoshape, _w_geoshape, _r_geoshape))
+        # widening toward the reference's ~30-type registry (Java's
+        # byte/short/char/array types collapse into Python int/bytes/list,
+        # so the meaningful additions are these)
+        import decimal as _decimal
+        self.register(AttributeHandler(
+            12, _decimal.Decimal,
+            lambda o, v: _w_str(o, str(v)),
+            lambda b: _decimal.Decimal(_r_str(b))))
+        def _ordinal(v) -> int:
+            # datetime IS a date subclass; silently truncating its time
+            # component under a date-typed key would be data loss
+            if isinstance(v, _dt.datetime):
+                raise TypeError(
+                    "datetime value under a date-typed key (use a datetime "
+                    "property key, or pass value.date() explicitly)")
+            return v.toordinal()
+
+        self.register(AttributeHandler(
+            13, _dt.date,
+            lambda o, v: o.put_svar(_ordinal(v)),
+            lambda b: _dt.date.fromordinal(b.get_svar()),
+            lambda o, v: _w_long_ordered(o, _ordinal(v)),
+            lambda b: _dt.date.fromordinal(_r_long_ordered(b))))
+        self.register(AttributeHandler(
+            14, _dt.time,
+            lambda o, v: _w_str(o, v.isoformat()),
+            lambda b: _dt.time.fromisoformat(_r_str(b))))
+
+        def _micros(v) -> int:
+            us = v.days * 86_400_000_000 + v.seconds * 1_000_000 \
+                + v.microseconds
+            if not (-(1 << 62) <= us < (1 << 62)):
+                # the order-preserving int codec is 63-bit; wrapping would
+                # silently corrupt value AND sort order
+                raise ValueError("timedelta out of 63-bit-microsecond range")
+            return us
+
+        self.register(AttributeHandler(
+            15, _dt.timedelta,
+            lambda o, v: o.put_svar(_micros(v)),
+            lambda b: _dt.timedelta(microseconds=b.get_svar()),
+            lambda o, v: _w_long_ordered(o, _micros(v)),
+            lambda b: _dt.timedelta(microseconds=_r_long_ordered(b))))
+        self.register(AttributeHandler(
+            16, tuple, lambda o, v: self._w_list(o, list(v)),
+            lambda b: tuple(self._r_list(b))))
+        self.register(AttributeHandler(
+            17, set, lambda o, v: self._w_list(o, sorted(v, key=repr)),
+            lambda b: set(self._r_list(b))))
+        self.register(AttributeHandler(
+            18, frozenset, lambda o, v: self._w_list(o, sorted(v, key=repr)),
+            lambda b: frozenset(self._r_list(b))))
 
     def register(self, h: AttributeHandler):
         if h.code in self._by_code or h.py_type in self._by_type:

@@ -45,9 +45,14 @@ import uuid as _uuid
 
 from titan_tpu.core.attribute import Geoshape as _Geoshape
 
+import decimal as _decimal
+
 for _n, _t in [("bool", bool), ("int", int), ("float", float), ("str", str),
                ("bytes", bytes), ("uuid", _uuid.UUID), ("datetime", _dt.datetime),
-               ("list", list), ("dict", dict), ("geoshape", _Geoshape)]:
+               ("list", list), ("dict", dict), ("geoshape", _Geoshape),
+               ("decimal", _decimal.Decimal), ("date", _dt.date),
+               ("time", _dt.time), ("timedelta", _dt.timedelta),
+               ("tuple", tuple), ("set", set), ("frozenset", frozenset)]:
     register_dtype(_n, _t)
 
 
