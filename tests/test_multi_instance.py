@@ -184,3 +184,45 @@ def test_ghost_rows_after_concurrent_delete(shared_dir):
     finally:
         g1.close()
         g2.close()
+
+
+def test_racing_schema_creation_converges(shared_dir):
+    """Lock-backed schema creation (reference: consistent-key locks on the
+    system name index): two instances auto-creating the same label at once
+    must converge on ONE schema id, and every committed edge must reference
+    that id (no rows orphaned under a loser's id)."""
+    g1 = _open(shared_dir, "a")
+    g2 = _open(shared_dir, "b")
+    assert g1.backend.locker is not None   # sqlite has no native locking
+    barrier = threading.Barrier(2)
+    errors = []
+
+    def writer(g, tag):
+        try:
+            barrier.wait(timeout=10)
+            tx = g.new_transaction()
+            u = tx.add_vertex("person", name=f"{tag}-u")
+            w = tx.add_vertex("person", name=f"{tag}-w")
+            tx.add_edge(u, "collides", w)
+            tx.commit()
+        except BaseException as e:   # noqa: BLE001
+            errors.append(e)
+
+    try:
+        t1 = threading.Thread(target=writer, args=(g1, "a"))
+        t2 = threading.Thread(target=writer, args=(g2, "b"))
+        t1.start(); t2.start(); t1.join(); t2.join()
+        assert not errors, errors
+        sid1 = g1.schema.get_by_name("collides").id
+        g2.schema.expire()
+        sid2 = g2.schema.get_by_name("collides").id
+        assert sid1 == sid2
+        # every edge written by either instance resolves under the winner id
+        tx = g1.new_transaction()
+        n_edges = sum(1 for v in tx.vertices()
+                      for _ in v.out_edges("collides"))
+        tx.rollback()
+        assert n_edges == 2
+    finally:
+        g1.close()
+        g2.close()

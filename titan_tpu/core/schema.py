@@ -15,6 +15,7 @@ keys default to (type(value), SINGLE); unknown edge labels to MULTI.
 from __future__ import annotations
 
 import threading
+import time as _time
 from dataclasses import dataclass, field as dc_field
 from typing import Optional
 
@@ -23,7 +24,10 @@ from titan_tpu.codec.edges import EdgeCodec
 from titan_tpu.core.defs import Cardinality, Multiplicity, SchemaStatus
 from titan_tpu.core.system_types import SystemTypes
 from titan_tpu.errors import (SchemaNameExistsError,
-                              SchemaViolationError)
+                              SchemaViolationError,
+                              TemporaryLockingError)
+from titan_tpu.storage.backend import INDEXSTORE_NAME
+from titan_tpu.storage.locking import LockID, LockState
 from titan_tpu.ids import IDManager, IDType
 from titan_tpu.storage.api import Entry, KeySliceQuery, SliceQuery
 
@@ -310,21 +314,47 @@ class SchemaManager:
         """Auto-schema creation that survives a racing creator (another
         thread or instance): if the create collides, adopt the winner.
         (reference: DefaultSchemaMaker under concurrent tx / the
-        schema-broadcast path; collisions resolve via the claim columns in
-        _store_type.)
+        schema-broadcast path.)
 
-        Known limit: if instance B writes DATA under its id in the window
-        before instance A's smaller claim lands, B's rows reference the
-        losing id (readable by id, orphaned from name lookups). The
-        reference closes this with consistent-key locks on schema creation;
-        production deployments should pre-create schema (auto_schema=False)
-        — same guidance as the reference."""
-        try:
-            st = make()
-        except SchemaNameExistsError:
-            # only the collision case — other schema errors propagate
-            self.expire(by_name=name)   # the peer's write made it stale
+        When the backend has a consistent-key locker, _store_type serializes
+        creation on a name lock (reference closes the same window with
+        consistent-key locks on the system name index), so a loser discovers
+        the winner BEFORE any data is written under its id. Without a locker
+        the claim-column protocol in _store_type still yields a deterministic
+        winner; pre-creating schema (auto_schema=False) remains the guidance
+        for locker-less eventually-consistent deployments."""
+        st = None
+        lock_exc: Optional[TemporaryLockingError] = None
+        for attempt in range(5):
+            try:
+                st = make()
+                break
+            except SchemaNameExistsError:
+                # only the collision case — other schema errors propagate
+                self.expire(by_name=name)   # the peer's write made it stale
+                st = self.get_by_name(name)
+                break
+            except TemporaryLockingError as e:
+                # a racing creator holds the name lock and may not have
+                # committed yet: poll for its write, else retry the creation
+                lock_exc = e
+                deadline = _time.monotonic() + 2.0
+                while _time.monotonic() < deadline:
+                    self.expire(by_name=name)
+                    st = self.get_by_name(name)
+                    if st is not None:
+                        break
+                    _time.sleep(0.02)
+                if st is not None:
+                    break
+        if st is None:
+            self.expire(by_name=name)
             st = self.get_by_name(name)
+        if st is None and lock_exc is not None:
+            # the lock never cleared (e.g. a crashed peer's claim outlives
+            # it until lock expiry) and nothing was committed under the
+            # name: surface the retriable condition, not a schema error
+            raise lock_exc
         if st is None or not isinstance(st, kind):
             raise SchemaViolationError(
                 f"{name!r} exists but is not a {kind.__name__}")
@@ -469,6 +499,37 @@ class SchemaManager:
         if expect_new and self.get_by_name(st.name) is not None:
             raise SchemaNameExistsError(
                 f"schema name already exists: {st.name!r}")
+        backend = self._graph.backend
+        locker = getattr(backend, "locker", None)
+        lock_state = None
+        if expect_new and locker is not None:
+            # Lock-backed creation (reference: consistent-key locking on the
+            # system name index): serialize creators of the same name so the
+            # loser learns of the winner BEFORE writing data under its id.
+            lock_state = LockState()
+            locker.write_lock(
+                LockID(INDEXSTORE_NAME, self._name_index_key(st.name),
+                       b"\x00sc"),
+                lock_state)
+            try:
+                winner = self._load_name_index(st.name)
+            except BaseException:
+                locker.release_locks(lock_state)
+                raise
+            if winner is not None:
+                # a racing creator committed before our lock claim landed
+                locker.release_locks(lock_state)
+                self.expire(by_name=st.name)
+                raise SchemaNameExistsError(
+                    f"schema name already exists: {st.name!r}")
+        try:
+            return self._store_type_locked(st, expect_new)
+        finally:
+            if lock_state is not None:
+                locker.release_locks(lock_state)
+
+    def _store_type_locked(self, st: SchemaType,
+                           expect_new: bool) -> SchemaType:
         backend = self._graph.backend
         txh = backend.manager.begin_transaction()
         try:
