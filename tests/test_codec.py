@@ -283,3 +283,27 @@ def test_sort_key_interval_query(codec, schema):
                            sort_start=[30], sort_end=[70])
     hits = sorted(time for time, e in entries if q.contains(e.column))
     assert hits == [30, 40, 50, 60]
+
+
+def test_property_meta_roundtrip_all_cardinalities(codec, schema):
+    """Meta-properties ride the value as an optional trailing section for
+    every cardinality; rows written without meta keep the legacy layout
+    byte-for-byte and both layouts parse."""
+    mk = schema.add_key(9, int)
+    mk2 = schema.add_key(10, str)
+    for card, count in [(Cardinality.SINGLE, 11), (Cardinality.SET, 12),
+                        (Cardinality.LIST, 13)]:
+        kid = schema.add_key(count, str, card)
+        plain = codec.write_property(kid, 77, "val", schema)
+        withmeta = codec.write_property(kid, 77, "val", schema,
+                                        properties={mk: 42, mk2: "m"})
+        # legacy layout untouched when no meta is present
+        assert plain == codec.write_property(kid, 77, "val", schema,
+                                             properties={})
+        for entry, want in [(plain, {}), (withmeta, {mk: 42, mk2: "m"})]:
+            rc = codec.parse(entry, schema)
+            assert rc.relation_id == 77 and rc.value == "val"
+            assert rc.properties == want, card
+        # the meta section must precede the backward relation id: a parser
+        # that peels the relid first still sees the right id
+        assert codec.parse(withmeta, schema).relation_id == 77

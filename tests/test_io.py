@@ -1,0 +1,171 @@
+"""Graph file IO round-trips (GraphSON-style JSON lines + binary snapshot).
+
+(reference: TitanIoTest / GraphSON-Gryo IO via TitanIoRegistry — the suite
+asserts a written-then-read graph preserves schema, elements, properties
+and special attribute types.)
+"""
+
+import datetime
+import decimal
+from datetime import timezone as _tz
+import uuid
+
+import pytest
+
+import titan_tpu
+from titan_tpu import io as tio
+from titan_tpu.core.attribute import Geoshape
+from titan_tpu.core.defs import Cardinality, Multiplicity
+
+
+@pytest.fixture
+def g():
+    g = titan_tpu.open("inmemory")
+    yield g
+    g.close()
+
+
+@pytest.fixture
+def g2():
+    g = titan_tpu.open("inmemory")
+    yield g
+    g.close()
+
+
+def _build_rich_graph(g):
+    mgmt = g.management()
+    name = mgmt.make_property_key("name", str)
+    nick = mgmt.make_property_key("nick", str, Cardinality.LIST)
+    when = mgmt.make_property_key("when", datetime.datetime)
+    mgmt.make_property_key("price", decimal.Decimal)
+    mgmt.make_edge_label("knows", Multiplicity.MULTI,
+                         sort_key=(when.id,))
+    mgmt.make_vertex_label("person")
+    mgmt.make_vertex_label("hub", partitioned=False, static=False)
+    mgmt.build_index("byName", "vertex").add_key(name) \
+        .build_composite_index()
+    mgmt.commit()
+
+    tx = g.new_transaction()
+    a = tx.add_vertex("person", name="alice")
+    p = tx.add_property(a, "nick", "ally")
+    tx.add_meta_property(p, "since", 2020)
+    tx.add_property(a, "nick", "al")
+    tx.add_property(a, "when",
+                    datetime.datetime(2021, 3, 4, 5, 6, 7, tzinfo=_tz.utc))
+    tx.add_property(a, "price", decimal.Decimal("12.50"))
+    tx.add_property(a, "uid", uuid.UUID(int=7))
+    tx.add_property(a, "blob", b"\x00\x01\xff")
+    tx.add_property(a, "spot", Geoshape.point(37.1, -122.3))
+    tx.add_property(a, "tags", ("x", "y"))
+    b = tx.add_vertex("person", name="bob")
+    c = tx.add_vertex(name="carol")   # unlabeled
+    tx.add_edge(a, "knows", b,
+                {"when": datetime.datetime(2022, 1, 1, tzinfo=_tz.utc),
+                 "weight": 0.5})
+    tx.add_edge(b, "knows", c, {"when": datetime.datetime(2023, 1, 1, tzinfo=_tz.utc)})
+    tx.commit()
+
+
+def _check_graph(g2):
+    # schema survived
+    schema = g2.schema
+    nick = schema.get_by_name("nick")
+    assert nick.cardinality is Cardinality.LIST
+    when = schema.get_by_name("when")
+    assert when.dtype is datetime.datetime
+    knows = schema.get_by_name("knows")
+    assert knows.multiplicity is Multiplicity.MULTI
+    assert [schema.get_type(k).name for k in knows.sort_key] == ["when"]
+    assert schema.get_by_name("person").is_vertex_label
+    idx = schema.get_by_name("byName")
+    assert idx.composite and \
+        [schema.get_type(k).name for k in idx.key_ids] == ["name"]
+
+    tx = g2.new_transaction()
+    alice = next(v for v in tx.vertices() if v.value("name") == "alice")
+    assert alice.label() == "person"
+    assert sorted(alice.values("nick")) == ["al", "ally"]
+    assert alice.value("when") == datetime.datetime(2021, 3, 4, 5, 6, 7, tzinfo=_tz.utc)
+    assert alice.value("price") == decimal.Decimal("12.50")
+    assert alice.value("uid") == uuid.UUID(int=7)
+    assert alice.value("blob") == b"\x00\x01\xff"
+    assert alice.value("spot") == Geoshape.point(37.1, -122.3)
+    assert alice.value("tags") == ("x", "y")
+    # meta-property on the "ally" nick
+    ally = next(p for p in alice.properties("nick") if p.value == "ally")
+    assert ally.meta("since") == 2020
+    assert ally.property_map() == {"since": 2020}
+    # edges + edge properties
+    e = next(iter(alice.out_edges("knows")))
+    assert e.in_vertex().value("name") == "bob"
+    assert e.value("when") == datetime.datetime(2022, 1, 1, tzinfo=_tz.utc)
+    assert e.value("weight") == 0.5
+    carol = next(v for v in tx.vertices() if v.value("name") == "carol")
+    assert carol.label() == "vertex"   # stayed unlabeled
+    # the composite index got populated during import
+    got = g2.traversal().V().has("name", "bob").to_list()
+    assert len(got) == 1
+    tx.rollback()
+
+
+def test_graphson_roundtrip(g, g2, tmp_path):
+    _build_rich_graph(g)
+    path = str(tmp_path / "graph.json")
+    out = tio.write_graphson(g, path)
+    assert out == {"vertices": 3, "edges": 2}
+    res = tio.read_graphson(g2, path)
+    assert res == {"vertices": 3, "edges": 2}
+    _check_graph(g2)
+
+
+def test_graphbin_roundtrip(g, g2, tmp_path):
+    _build_rich_graph(g)
+    path = str(tmp_path / "graph.bin")
+    out = tio.write_graphbin(g, path)
+    assert out == {"vertices": 3, "edges": 2}
+    res = tio.read_graphbin(g2, path)
+    assert res == {"vertices": 3, "edges": 2}
+    _check_graph(g2)
+
+
+def test_graph_of_the_gods_roundtrip(g, g2, tmp_path):
+    from titan_tpu.example import load
+    load(g)
+    path = str(tmp_path / "gods.json")
+    out = tio.write_graphson(g, path)
+    res = tio.read_graphson(g2, path)
+    assert res == out and out["vertices"] == 12
+    # same 2-hop result through the traversal DSL
+    a = sorted(g.traversal().V().has("name", "hercules")
+               .out("father").out("lives").values("name").to_list())
+    b = sorted(g2.traversal().V().has("name", "hercules")
+               .out("father").out("lives").values("name").to_list())
+    assert a == b and a
+    g.close()
+
+
+def test_graphson_batched_import(g, g2, tmp_path):
+    tx = g.new_transaction()
+    vs = [tx.add_vertex(n=i) for i in range(50)]
+    for i in range(49):
+        tx.add_edge(vs[i], "next", vs[i + 1])
+    tx.commit()
+    path = str(tmp_path / "chain.json")
+    tio.write_graphson(g, path)
+    res = tio.read_graphson(g2, path, batch_size=7)  # many tx boundaries
+    assert res == {"vertices": 50, "edges": 49}
+    chain = g2.traversal().V().has("n", 0).out("next").out("next") \
+        .values("n").to_list()
+    assert chain == [2]
+
+
+def test_bad_files(g2, tmp_path):
+    p = tmp_path / "x.json"
+    p.write_text('{"something": 1}\n')
+    with pytest.raises(titan_tpu.errors.TitanError):
+        tio.read_graphson(g2, str(p))
+    pb = tmp_path / "x.bin"
+    pb.write_bytes(b"NOTBIN")
+    with pytest.raises(titan_tpu.errors.TitanError):
+        tio.read_graphbin(g2, str(pb))

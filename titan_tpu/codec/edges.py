@@ -86,7 +86,13 @@ class EdgeCodec:
     # -- properties ----------------------------------------------------------
 
     def write_property(self, key_id: int, relation_id: int, value: Any,
-                       inspector: TypeInspector) -> Entry:
+                       inspector: TypeInspector,
+                       properties: Optional[dict] = None) -> Entry:
+        """``properties`` are META-properties (properties on the property —
+        reference: TitanVertexProperty.property()); they ride the value as
+        an optional trailing section, exactly like an edge's non-sort-key
+        properties (EdgeSerializer.writeRelation's 'remaining properties').
+        Omitted when empty, so rows without meta keep the legacy layout."""
         card = inspector.cardinality(key_id)
         col = DataOutput()
         rids.write_relation_type(col, key_id, self.idm,
@@ -94,13 +100,17 @@ class EdgeCodec:
         val = DataOutput()
         if card is Cardinality.SINGLE:
             self.serializer.write_value(val, value)
-            val.put_uvar_backward(relation_id)
         elif card is Cardinality.SET:
             self._write_set_value(col, value, inspector.data_type(key_id))
-            val.put_uvar_backward(relation_id)
         else:  # LIST
             col.put_uvar(relation_id)
             self.serializer.write_value(val, value)
+        if properties:
+            # same wire shape as an edge's non-sort-key properties
+            self._write_props(val, key_id, properties, inspector,
+                              skip_sort=False)
+        if card is not Cardinality.LIST:
+            val.put_uvar_backward(relation_id)
         return Entry(col.getvalue(), val.getvalue())
 
     def _write_set_value(self, out: DataOutput, value: Any, dtype: type):
@@ -188,8 +198,12 @@ class EdgeCodec:
         else:  # LIST
             relation_id = col.get_uvar()
             value = self.serializer.read_value(val)
+        props: dict = {}
+        if val.has_remaining():   # optional trailing meta-property section
+            self._read_props(val, props)
         return RelationCache(relation_id, key_id, Direction.OUT,
-                             RelationCategory.PROPERTY, value=value)
+                             RelationCategory.PROPERTY, value=value,
+                             properties=props)
 
     def _parse_edge(self, label_id: int, direction: Direction, col: ReadBuffer,
                     val: ReadBuffer, inspector: TypeInspector) -> RelationCache:

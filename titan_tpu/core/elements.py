@@ -124,6 +124,12 @@ class RelationElement(Element):
     def type_name(self) -> str:
         return self.tx.schema_name(self.rel.type_id)
 
+    def property_map(self) -> dict:
+        """Inline properties by key name: edge properties on an Edge,
+        meta-properties on a VertexProperty."""
+        return {self.tx.schema_name(kid): v
+                for kid, v in self.rel.properties.items()}
+
     def remove(self) -> None:
         self.tx.remove_relation(self.rel)
 
@@ -155,10 +161,6 @@ class Edge(RelationElement):
     def values(self, *keys: str) -> list:
         return [self.value(k) for k in keys]
 
-    def property_map(self) -> dict:
-        return {self.tx.schema_name(kid): v
-                for kid, v in self.rel.properties.items()}
-
     def __repr__(self):
         return (f"e[{self._id}][{self.rel.out_vertex_id}-"
                 f"{self.label()}->{self.rel.in_vertex_id}]")
@@ -173,6 +175,14 @@ class VertexProperty(RelationElement):
     @property
     def value(self) -> Any:
         return self.rel.value
+
+    def meta(self, key: str, default: Any = None) -> Any:
+        """Read a meta-property (reference: TitanVertexProperty.value(key));
+        set via tx.add_meta_property."""
+        st = self.tx.schema.get_by_name(key)
+        if st is None:
+            return default
+        return self.rel.properties.get(st.id, default)
 
     def element(self) -> Vertex:
         return self.tx.vertex_handle(self.rel.out_vertex_id)

@@ -474,7 +474,8 @@ class StandardGraph:
         the row key is representative-routed."""
         if rel.is_property:
             yield rel.out_vertex_id, self.codec.write_property(
-                rel.type_id, rel.relation_id, rel.value, self.schema)
+                rel.type_id, rel.relation_id, rel.value, self.schema,
+                rel.properties)
             return
         # edge: OUT row always; IN row unless unidirected or endpoint is a
         # schema vertex (vertex-label edges only materialize on the OUT side)

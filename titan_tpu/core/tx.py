@@ -201,13 +201,7 @@ class GraphTransaction:
             raise SchemaViolationError("read-only transaction")
         self._check_vertex_writable(v.id)
         pk = self.schema.get_or_create_key(key, value)
-        if not isinstance(value, pk.dtype) and pk.dtype is not None:
-            coerced = self._coerce(value, pk.dtype)
-            if coerced is None:
-                raise SchemaViolationError(
-                    f"value {value!r} is not a {pk.dtype.__name__} "
-                    f"(key {key!r})")
-            value = coerced
+        value = self._validate_value(pk, key, value)
         if pk.cardinality is Cardinality.SINGLE:
             for p in self.vertex_properties(v.id, [key]):
                 self.remove_relation(p.rel)
@@ -219,6 +213,36 @@ class GraphTransaction:
             self.graph.id_assigner.next_relation_id(), pk.id,
             RelationCategory.PROPERTY, v.id, value=value))
         return VertexProperty(self, rel)
+
+    def add_meta_property(self, p: VertexProperty, key: str,
+                          value: Any) -> VertexProperty:
+        """Attach a meta-property to a vertex property (reference:
+        TitanVertexProperty.property() — properties ON properties ride the
+        owning relation's inline property map, like edge properties).
+        Only supported on properties added in this transaction: meta data
+        is serialized with the relation when it is first written."""
+        self._check_open()
+        if self.read_only:
+            raise SchemaViolationError("read-only transaction")
+        if p.rel.relation_id not in self._added:
+            raise SchemaViolationError(
+                "meta-properties can only be set on properties added in "
+                "the same transaction (remove the property and re-add it, "
+                "then set the meta-property before commit)")
+        pk = self.schema.get_or_create_key(key, value)
+        p.rel.properties[pk.id] = self._validate_value(pk, key, value)
+        return p
+
+    def _validate_value(self, pk, key: str, value: Any) -> Any:
+        """Enforce the key's declared dtype, coercing where lossless."""
+        if pk.dtype is not None and not isinstance(value, pk.dtype):
+            coerced = self._coerce(value, pk.dtype)
+            if coerced is None:
+                raise SchemaViolationError(
+                    f"value {value!r} is not a {pk.dtype.__name__} "
+                    f"(key {key!r})")
+            value = coerced
+        return value
 
     @staticmethod
     def _coerce(value, dtype):
@@ -242,7 +266,7 @@ class GraphTransaction:
             RelationCategory.EDGE, out_v.id, in_v.id)
         for k, val in (props or {}).items():
             pk = self.schema.get_or_create_key(k, val)
-            rel.properties[pk.id] = val
+            rel.properties[pk.id] = self._validate_value(pk, k, val)
         self._add_relation(rel)
         return Edge(self, rel)
 
@@ -499,6 +523,7 @@ class GraphTransaction:
         if rc.category is RelationCategory.PROPERTY:
             return InternalRelation(rc.relation_id, rc.type_id, rc.category,
                                     vid, value=rc.value,
+                                    properties=dict(rc.properties),
                                     lifecycle=ElementLifecycle.LOADED)
         if rc.direction is Direction.OUT:
             out_id, in_id = vid, rc.other_vertex_id
