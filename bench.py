@@ -43,9 +43,15 @@ def main() -> None:
     source = int(np.flatnonzero(deg > 0)[0])
 
     # frontier-sparse BFS (O(E) total work; see PERF_NOTES.md); sharded
-    # over all chips when more than one is attached
+    # over all chips when more than one is attached; tiled (vertex-range
+    # CSR shards, int32-safe) when the edge count overflows int32 indices
     ndev = jax.device_count()
-    if ndev > 1:
+    if snap.num_edges >= (1 << 31):
+        # >= 2^31 directed edges: only the tiled path is int32-safe (the
+        # mesh-sharded path still indexes the whole edge array per chip)
+        from titan_tpu.models.bfs import frontier_bfs_tiled
+        run_bfs = lambda: frontier_bfs_tiled(snap, source)  # noqa: E731
+    elif ndev > 1:
         from titan_tpu.models.bfs import frontier_bfs_sharded
         from titan_tpu.parallel.mesh import vertex_mesh
         mesh = vertex_mesh(ndev)

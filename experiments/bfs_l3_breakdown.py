@@ -1,0 +1,81 @@
+"""Time each op of the frontier level step at L3 shapes (scale 23)."""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.olap.tpu.rmat import rmat_edges
+
+scale = 23
+n = 1 << scale
+src, dst = rmat_edges(scale, 16, seed=2)
+s2 = np.concatenate([src, dst])
+d2 = np.concatenate([dst, src])
+snap = snap_mod.from_arrays(n, s2, d2)
+dst_by_src, indptr_out = snap.out_csr()
+dst_d = jnp.asarray(dst_by_src)
+ip_d = jnp.asarray(indptr_out.astype(np.int32))
+deg_d = jnp.asarray(snap.out_degree.astype(np.int32))
+
+F = 1 << 21
+M = 1 << 28
+rng = np.random.default_rng(1)
+frontier = jnp.asarray(rng.permutation(n)[:F].astype(np.int32))
+nbr = jnp.asarray(rng.integers(0, n, (M,), dtype=np.int32))
+eidx = jnp.asarray(rng.integers(0, len(dst_by_src), (M,), dtype=np.int32))
+dist0 = jnp.full((n + 1,), 1 << 30, jnp.int32)
+vals = jnp.asarray(rng.integers(0, 2, (M,), dtype=np.int32))
+
+
+def timed(name, f, *args):
+    g = jax.jit(f)
+    np.asarray(g(*args))
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        np.asarray(g(*args))
+        best = min(best, time.time() - t0)
+    print(f"{name:42s}{best*1e3:9.1f} ms")
+
+
+timed("deg/ip gathers (F)", lambda fr: (deg_d[fr] + ip_d[fr]).sum(), frontier)
+timed("cumsum F", lambda fr: jnp.cumsum(deg_d[fr]).sum(), frontier)
+timed("delta scatter+cumsum M",
+      lambda d: (jnp.zeros((M,), jnp.int32).at[d[:F]].add(7, mode="drop")
+                 .cumsum()[::65536]).sum(), nbr)
+timed("edge gather dst_arr[eidx] (M)",
+      lambda e: dst_d[jnp.clip(e, 0, dst_d.shape[0] - 1)][::65536].sum(),
+      eidx)
+timed("edge gather no-clip (M)",
+      lambda e: dst_d[e][::65536].sum(), eidx)
+timed("where(j<m, gather, n) full expr (M)",
+      lambda e: jnp.where(jnp.arange(M) < (M - 3),
+                          dst_d[jnp.clip(e, 0, dst_d.shape[0] - 1)],
+                          n)[::65536].sum(), eidx)
+timed("scatter-min dist.at[nbr].min (M->n)",
+      lambda d, v: d.at[v].min(3)[::65536].sum(), dist0, nbr)
+timed("scatter-min mode=drop",
+      lambda d, v: d.at[v].min(3, mode="drop")[::65536].sum(), dist0, nbr)
+timed("scatter-min unique_indices hint",
+      lambda d, v: d.at[v].min(3, unique_indices=True)[::65536].sum(),
+      dist0, nbr)
+timed("changed+counts (n)",
+      lambda d: ((d == 3) & (jnp.arange(n + 1) < n)).sum(), dist0)
+timed("m_next sum (n)",
+      lambda d: jnp.where((d == 3)[:n], deg_d, 0).sum(dtype=jnp.int32),
+      dist0)
+timed("nonzero size=n",
+      lambda d: jnp.nonzero((d == 3)[:n], size=n, fill_value=n)[0][::65536]
+      .sum().astype(jnp.int32), dist0)
+timed("nonzero size=2^22",
+      lambda d: jnp.nonzero((d == 3)[:n], size=1 << 22, fill_value=n)[0]
+      [::65536].sum().astype(jnp.int32), dist0)

@@ -1,0 +1,65 @@
+"""Per-level timing of frontier_bfs at bench scale (reuses snapshot cache)."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+
+from titan_tpu.models import bfs as bfs_mod
+from titan_tpu.models.bfs import INF, _frontier_level_step, _next_pow2
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.olap.tpu.rmat import rmat_edges
+
+scale = int(sys.argv[1]) if len(sys.argv) > 1 else 23
+
+t0 = time.time()
+src, dst = rmat_edges(scale, 16, seed=2)
+n = 1 << scale
+s2 = np.concatenate([src, dst])
+d2 = np.concatenate([dst, src])
+snap = snap_mod.from_arrays(n, s2, d2)
+print(f"gen {time.time()-t0:.1f}s")
+
+deg = snap.out_degree
+source = int(np.flatnonzero(deg > 0)[0])
+
+e_total = int(snap.num_edges)
+dst_by_src, indptr_out = snap.out_csr()
+dev = {
+    "dst_by_src": jnp.asarray(dst_by_src),
+    "indptr_out": jnp.asarray(indptr_out.astype(np.int32)),
+    "out_degree": jnp.asarray(snap.out_degree.astype(np.int32)),
+}
+level_step = _frontier_level_step()
+
+
+def run(tag):
+    dist = jnp.full((n + 1,), INF, jnp.int32).at[source].set(0)
+    frontier_full = jnp.full((n,), n, jnp.int32).at[0].set(source)
+    f_count, m_total, level = 1, int(deg[source]), 0
+    tot = 0.0
+    while f_count > 0 and m_total > 0 and level < 1000:
+        t1 = time.time()
+        f_cap = min(_next_pow2(f_count), n)
+        m_cap = min(_next_pow2(m_total), max(_next_pow2(e_total), 2))
+        dist, frontier_full, nf, m_next = level_step(
+            dist, frontier_full[:f_cap], jnp.int32(f_count),
+            jnp.int32(level), dev["dst_by_src"], dev["indptr_out"],
+            dev["out_degree"], f_cap=f_cap, m_cap=m_cap, n_=n)
+        nf_i, m_i = int(nf), int(m_next)
+        dt = time.time() - t1
+        tot += dt
+        print(f"{tag} L{level}: f={f_count:9d} m={m_total:10d} "
+              f"f_cap={f_cap:9d} m_cap={m_cap:10d}  {dt*1e3:9.1f} ms")
+        f_count, m_total = nf_i, m_i
+        level += 1
+    print(f"{tag} total {tot:.2f}s")
+
+
+run("warm")
+run("hot ")

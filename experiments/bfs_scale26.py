@@ -1,0 +1,44 @@
+"""Graph500 scale-26 single-chip capability run (2^31 directed edges)."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from titan_tpu.models.bfs import INF, frontier_bfs_tiled
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.olap.tpu.rmat import rmat_edges
+
+scale = int(sys.argv[1]) if len(sys.argv) > 1 else 26
+
+t0 = time.time()
+src, dst = rmat_edges(scale, 16, seed=2)
+print(f"rmat {time.time()-t0:.0f}s", flush=True)
+n = 1 << scale
+s2 = np.concatenate([src, dst])
+d2 = np.concatenate([dst, src])
+del src, dst
+t1 = time.time()
+snap = snap_mod.from_arrays(n, s2, d2)
+print(f"snapshot {time.time()-t1:.0f}s  E={snap.num_edges}", flush=True)
+t2 = time.time()
+snap.out_csr()
+print(f"out_csr {time.time()-t2:.0f}s", flush=True)
+
+source = int(np.flatnonzero(snap.out_degree > 0)[0])
+t3 = time.time()
+dist, lv = frontier_bfs_tiled(snap, source)
+print(f"warm bfs {time.time()-t3:.0f}s levels={lv}", flush=True)
+best = float("inf")
+for _ in range(2):
+    t4 = time.time()
+    dist, lv = frontier_bfs_tiled(snap, source)
+    best = min(best, time.time() - t4)
+reach = dist < int(INF)
+m = int(np.count_nonzero(reach[s2]) // 2)
+print(f"scale{scale}: best {best:.2f}s levels {lv} "
+      f"reach {int(reach.sum())} TEPS {m/best/1e6:.1f}M", flush=True)

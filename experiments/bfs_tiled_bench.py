@@ -1,0 +1,40 @@
+"""Tiled vs bucketed frontier BFS at bench scale on the real chip."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from titan_tpu.models.bfs import INF, frontier_bfs, frontier_bfs_tiled
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.olap.tpu.rmat import rmat_edges
+
+scale = int(sys.argv[1]) if len(sys.argv) > 1 else 23
+t0 = time.time()
+src, dst = rmat_edges(scale, 16, seed=2)
+n = 1 << scale
+s2 = np.concatenate([src, dst])
+d2 = np.concatenate([dst, src])
+snap = snap_mod.from_arrays(n, s2, d2)
+print(f"gen {time.time()-t0:.1f}s", flush=True)
+source = int(np.flatnonzero(snap.out_degree > 0)[0])
+
+for name, fn in [
+    ("tiled", lambda: frontier_bfs_tiled(snap, source)),
+    ("bucketed", lambda: frontier_bfs(snap, source)),
+]:
+    t1 = time.time()
+    dist, lv = fn()
+    warm = time.time() - t1
+    best = float("inf")
+    for _ in range(2):
+        t2 = time.time()
+        dist, lv = fn()
+        best = min(best, time.time() - t2)
+    m = int(np.count_nonzero((dist < int(INF))[s2]) // 2)
+    print(f"{name:9s} warm {warm:7.1f}s best {best:7.2f}s levels {lv} "
+          f"TEPS {m/best/1e6:.1f}M", flush=True)

@@ -1,0 +1,185 @@
+"""Measure real rates of the usable Mosaic primitives at scale.
+
+dynamic_gather axis=0 supports ONLY a one-vreg table (8 rows for int32):
+"Multiple source vregs along gather dimension" otherwise. So we measure:
+  - lane shuffle (axis=1): per-row 128-entry lookup
+  - vreg-local sublane gather: out[i,j] = T[idx[i,j], j] with T (8,128)
+    tiled across rows (idx values in [0,8))
+  - transpose rate (128x128 tiles)
+  - XLA cumsums (segment-op building blocks)
+
+Run:  python experiments/probe2_rates.py
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+R = 2048           # rows per grid block
+STEPS = 1024
+M = R * STEPS * 128   # 268M elements == bench edge count
+
+
+def timed(fn, *args, reps=3):
+    np.asarray(fn(*args))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        np.asarray(fn(*args))
+        best = min(best, time.time() - t0)
+    return best
+
+
+def report(name, t):
+    print(f"{name:36s}{t*1e3:9.1f} ms  {M/t/1e9:7.2f} G elem/s")
+
+
+def stream1(kernel, nin, out_dtype=jnp.int32):
+    def f(*args):
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((R * STEPS, 128), out_dtype),
+            grid=(STEPS,),
+            in_specs=[pl.BlockSpec((R, 128), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)] * nin,
+            out_specs=pl.BlockSpec((R, 128), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+        )(*args)
+        return out[::R * 16].sum()
+    return jax.jit(f)
+
+
+# lane shuffle
+def shuffle_kernel(v_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take_along_axis(v_ref[:], idx_ref[:], axis=1)
+
+
+# vreg-local sublane gather from an (8,128) table tiled across rows
+def vreg_gather_kernel(tabtile_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take_along_axis(tabtile_ref[:], idx_ref[:], axis=0)
+
+
+def vreg_gather(tab8, idx):
+    # tab8: (8,128); tile it R/8 times inside the kernel? tiling in-kernel
+    # via jnp.tile lowers to broadcast ops; measure with pre-tiled operand
+    # streamed from HBM first (upper bound on memory), then in-kernel tile.
+    tiled = jnp.tile(tab8, (R // 8, 1))
+
+    def kernel(idx_ref, out_ref, tile_ref):
+        out_ref[:] = jnp.take_along_axis(tile_ref[:], idx_ref[:], axis=0)
+
+    @jax.jit
+    def f(idx):
+        out = pl.pallas_call(
+            lambda idx_ref, out_ref: kernel(idx_ref, out_ref, None)
+            if False else None,
+            out_shape=jax.ShapeDtypeStruct((R * STEPS, 128), jnp.int32),
+        )(idx)
+        return out
+    # simpler: pass tiled as a broadcast block input
+    def kernel2(tile_ref, idx_ref, out_ref):
+        out_ref[:] = jnp.take_along_axis(tile_ref[:], idx_ref[:], axis=0)
+
+    @jax.jit
+    def g(tiled, idx):
+        out = pl.pallas_call(
+            kernel2,
+            out_shape=jax.ShapeDtypeStruct((R * STEPS, 128), jnp.int32),
+            grid=(STEPS,),
+            in_specs=[
+                pl.BlockSpec((R, 128), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((R, 128), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((R, 128), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+        )(tiled, idx)
+        return out[::R * 16].sum()
+    return g, tiled
+
+
+# two-step 1024-entry lookup: sublane gather (8 rows) + pre-placed lanes
+def lookup1024_kernel(tile_ref, rowsel_ref, shift_ref, out_ref):
+    w = jnp.take_along_axis(tile_ref[:], rowsel_ref[:], axis=0)
+    out_ref[:] = (w >> shift_ref[:]) & 1
+
+
+def lookup1024(tiled, rowsel, shift):
+    @jax.jit
+    def f(tiled, rowsel, shift):
+        out = pl.pallas_call(
+            lookup1024_kernel,
+            out_shape=jax.ShapeDtypeStruct((R * STEPS, 128), jnp.int32),
+            grid=(STEPS,),
+            in_specs=[
+                pl.BlockSpec((R, 128), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((R, 128), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((R, 128), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((R, 128), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+        )(tiled, rowsel, shift)
+        return out[::R * 16].sum()
+    return f
+
+
+# transpose throughput on (128,128) subtiles within each block
+def transpose_kernel(v_ref, out_ref):
+    for k in range(R // 128):
+        out_ref[k * 128:(k + 1) * 128, :] = v_ref[k * 128:(k + 1) * 128, :].T
+
+
+@jax.jit
+def xla_cumsum0(v):
+    return jnp.cumsum(v, axis=0)[::R * 16].sum()
+
+
+@jax.jit
+def xla_cumsum_flat(v):
+    return jnp.cumsum(v.reshape(-1))[::R * 128 * 16].sum()
+
+
+def main():
+    rng = np.random.default_rng(0)
+    sidx = jnp.asarray(rng.integers(0, 128, (R * STEPS, 128), dtype=np.int32))
+    rsel = jnp.asarray(rng.integers(0, 8, (R * STEPS, 128), dtype=np.int32))
+    shift = jnp.asarray(rng.integers(0, 32, (R * STEPS, 128), dtype=np.int32))
+    val = jnp.asarray(rng.integers(0, 100, (R * STEPS, 128), dtype=np.int32))
+    tab8 = jnp.asarray(rng.integers(0, 1 << 20, (8, 128), dtype=np.int32))
+    tiled = jnp.tile(tab8, (R // 8, 1))
+
+    report("lane shuffle (pallas)",
+           timed(stream1(shuffle_kernel, 2), val, sidx))
+
+    g, tiled_arr = vreg_gather(tab8, rsel)
+    try:
+        report("vreg sublane gather (8-row tab)", timed(g, tiled_arr, rsel))
+    except Exception as e:  # noqa: BLE001
+        print("vreg sublane gather FAILED:", str(e)[:200])
+
+    try:
+        f = lookup1024(tiled, rsel, shift)
+        report("1024-word bit lookup (fused)", timed(f, tiled, rsel, shift))
+    except Exception as e:  # noqa: BLE001
+        print("1024-word lookup FAILED:", str(e)[:200])
+
+    report("transpose 128x128 tiles (pallas)",
+           timed(stream1(transpose_kernel, 1), val))
+    report("stream copy ref (pallas)",
+           timed(stream1(lambda i, o: o.__setitem__(slice(None), i[:]), 1),
+                 val))
+    report("XLA cumsum axis=0 (2M,128)", timed(xla_cumsum0, val))
+    report("XLA cumsum flat 1D (268M)", timed(xla_cumsum_flat, val))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,179 @@
+"""Bisect which DMA construct crashes the TPU compiler."""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+E = 1 << 22
+edges = jnp.asarray(np.arange(E, dtype=np.int32))
+starts = jnp.asarray((np.arange(1024, dtype=np.int32) * 128) % (E - 256))
+
+
+def try_case(name, fn):
+    try:
+        out = fn()
+        np.asarray(out)
+        t0 = time.time()
+        np.asarray(fn())
+        print(f"{name}: OK  {1e3*(time.time()-t0):.1f} ms")
+    except Exception as e:  # noqa: BLE001
+        print(f"{name}: FAIL {str(e)[:150]}")
+
+
+# V1: one static HBM->HBM DMA
+def v1():
+    def kernel(src, out, sem):
+        cp = pltpu.make_async_copy(src.at[pl.ds(0, 128)],
+                                   out.at[pl.ds(0, 128)], sem)
+        cp.start()
+        cp.wait()
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((256,), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(edges)
+
+
+# V2: HBM->VMEM then VMEM->HBM, static
+def v2():
+    def kernel(src, out, buf, sem):
+        cp = pltpu.make_async_copy(src.at[pl.ds(0, 128)], buf.at[pl.ds(0, 128)], sem)
+        cp.start()
+        cp.wait()
+        cp2 = pltpu.make_async_copy(buf.at[pl.ds(0, 128)], out.at[pl.ds(0, 128)], sem)
+        cp2.start()
+        cp2.wait()
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((256,), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.VMEM((128,), jnp.int32),
+                        pltpu.SemaphoreType.DMA(())],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(edges)
+
+
+# V3: dynamic offset from prefetched scalar
+def v3():
+    def kernel(st, src, out, sem):
+        s = st[0]
+        cp = pltpu.make_async_copy(src.at[pl.ds(s, 128)],
+                                   out.at[pl.ds(0, 128)], sem)
+        cp.start()
+        cp.wait()
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((256,), jnp.int32),
+        grid_spec=gs,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(starts, edges)
+
+
+# V4: fori_loop of dynamic-offset DMAs, one sem
+def v4():
+    def kernel(st, src, out, sem):
+        def body(k, _):
+            s = st[k]
+            cp = pltpu.make_async_copy(src.at[pl.ds(s, 128)],
+                                       out.at[pl.ds(k * 128, 128)], sem)
+            cp.start()
+            cp.wait()
+            return 0
+        jax.lax.fori_loop(0, 1024, body, 0)
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1024 * 128,), jnp.int32),
+        grid_spec=gs,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(starts, edges)
+
+
+# V5: like V4 but pl.when guard on the DMA
+def v5():
+    def kernel(st, src, out, sem):
+        def body(k, _):
+            s = st[k]
+
+            @pl.when(s >= 0)
+            def _():
+                cp = pltpu.make_async_copy(src.at[pl.ds(s, 128)],
+                                           out.at[pl.ds(k * 128, 128)], sem)
+                cp.start()
+                cp.wait()
+            return 0
+        jax.lax.fori_loop(0, 1024, body, 0)
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1024 * 128,), jnp.int32),
+        grid_spec=gs,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(starts, edges)
+
+
+# V6: semaphore ARRAY indexed dynamically
+def v6():
+    def kernel(st, src, out, sems):
+        def body(k, _):
+            s = st[k]
+            cp = pltpu.make_async_copy(src.at[pl.ds(s, 128)],
+                                       out.at[pl.ds(k * 128, 128)],
+                                       sems.at[k % 8])
+            cp.start()
+            cp.wait()
+            return 0
+        jax.lax.fori_loop(0, 1024, body, 0)
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((8,))],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1024 * 128,), jnp.int32),
+        grid_spec=gs,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(starts, edges)
+
+
+for name, fn in [("V1 static hbm->hbm", v1), ("V2 via vmem", v2),
+                 ("V3 dyn offset", v3), ("V4 loop dyn DMA", v4),
+                 ("V5 loop + when", v5), ("V6 sem array", v6)]:
+    try_case(name, fn)

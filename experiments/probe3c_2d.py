@@ -1,0 +1,120 @@
+"""DMA bisect round 2: 2D shapes."""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+R = 1 << 15
+edges2d = jnp.asarray(np.arange(R * 128, dtype=np.int32).reshape(R, 128))
+starts = jnp.asarray((np.arange(4096, dtype=np.int32) * 7) % (R - 8))
+
+
+def try_case(name, fn):
+    try:
+        out = fn()
+        np.asarray(out)
+        t0 = time.time()
+        np.asarray(fn())
+        print(f"{name}: OK  {1e3*(time.time()-t0):.1f} ms")
+    except Exception as e:  # noqa: BLE001
+        print(f"{name}: FAIL {str(e)[:150]}")
+
+
+# W1: one static HBM->HBM DMA, 2D row copy
+def w1():
+    def kernel(src, out, sem):
+        cp = pltpu.make_async_copy(src.at[pl.ds(0, 8), :],
+                                   out.at[pl.ds(0, 8), :], sem)
+        cp.start()
+        cp.wait()
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(edges2d)
+
+
+# W2: loop of dynamic-row-offset HBM->VMEM-out DMAs
+def w2():
+    def kernel(st, src, out, sem):
+        def body(k, _):
+            s = st[k]
+            cp = pltpu.make_async_copy(src.at[pl.ds(s, 8), :],
+                                       out.at[pl.ds(k * 8, 8), :], sem)
+            cp.start()
+            cp.wait()
+            return 0
+        jax.lax.fori_loop(0, 1024, body, 0)
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(())],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1024 * 8, 128), jnp.int32),
+        grid_spec=gs,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(starts, edges2d)
+
+
+# W3: 4096 segments, 8 in-flight sems, no wait-before-start pipelining
+def w3():
+    NSEG = 4096
+
+    def kernel(st, src, out, sems):
+        # start 8 ahead, wait round-robin
+        def body(k, _):
+            slot = k % 8
+
+            @pl.when(k >= 8)
+            def _():
+                pltpu.make_async_copy(
+                    src.at[pl.ds(st[k - 8], 8), :],
+                    out.at[pl.ds((k - 8) * 8, 8), :],
+                    sems.at[slot]).wait()
+
+            pltpu.make_async_copy(src.at[pl.ds(st[k], 8), :],
+                                  out.at[pl.ds(k * 8, 8), :],
+                                  sems.at[slot]).start()
+            return 0
+        jax.lax.fori_loop(0, NSEG, body, 0)
+        # drain
+        def drain(k, _):
+            pltpu.make_async_copy(
+                src.at[pl.ds(st[NSEG - 8 + k], 8), :],
+                out.at[pl.ds((NSEG - 8 + k) * 8, 8), :],
+                sems.at[(NSEG - 8 + k) % 8]).wait()
+            return 0
+        jax.lax.fori_loop(0, 8, drain, 0)
+
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((8,))],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((4096 * 8, 128), jnp.int32),
+        grid_spec=gs,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(starts, edges2d)
+
+
+for name, fn in [("W1 static 2d", w1), ("W2 loop dyn 2d", w2),
+                 ("W3 pipelined 4096", w3)]:
+    try_case(name, fn)

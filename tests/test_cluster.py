@@ -1,0 +1,164 @@
+"""Sharded + replicated remote-cluster backend.
+
+(reference role: the Cassandra/HBase cluster under titan-cassandra /
+titan-hbase — partitioned + replicated key placement with consistency
+levels; exercised here with N in-process KCVSServer nodes.)
+"""
+
+import pytest
+
+import titan_tpu
+from titan_tpu.errors import TemporaryBackendError
+from titan_tpu.storage.api import (Entry, KeyRangeQuery, KeySliceQuery,
+                                   SliceQuery)
+from titan_tpu.storage.cluster import ClusterStoreManager, HashRing
+from titan_tpu.storage.inmemory import InMemoryStoreManager
+from titan_tpu.storage.remote import KCVSServer
+
+
+@pytest.fixture
+def nodes():
+    servers = [KCVSServer(InMemoryStoreManager()).start() for _ in range(3)]
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+def hosts_of(servers):
+    return [f"127.0.0.1:{s.port}" for s in servers]
+
+
+def make_mgr(servers, rf=2, wc="all"):
+    return ClusterStoreManager(hosts_of(servers), replication=rf,
+                               write_consistency=wc, virtual_nodes=16)
+
+
+def test_ring_distinct_replicas():
+    ring = HashRing(5, 3, 32, [f"n{i}" for i in range(5)])
+    for k in range(200):
+        reps = ring.replicas(b"key%d" % k)
+        assert len(reps) == 3 and len(set(reps)) == 3
+
+
+def test_ring_spread():
+    ring = HashRing(4, 1, 64, [f"n{i}" for i in range(4)])
+    counts = [0] * 4
+    for k in range(2000):
+        counts[ring.replicas(b"key%d" % k)[0]] += 1
+    assert min(counts) > 200   # no starving node
+
+def test_slice_and_scan_with_replication(nodes):
+    mgr = make_mgr(nodes, rf=2)
+    store = mgr.open_database("s")
+    txh = mgr.begin_transaction()
+    for i in range(60):
+        store.mutate(b"k%03d" % i, [Entry(b"c", b"%d" % i)], [], txh)
+    # reads
+    assert store.get_slice(KeySliceQuery(b"k007", SliceQuery()), txh) == \
+        [Entry(b"c", b"7")]
+    multi = store.get_slice_multi([b"k003", b"k017", b"k042"],
+                                  SliceQuery(), txh)
+    assert multi[b"k042"] == [Entry(b"c", b"42")]
+    # ordered scan: globally ordered, duplicates collapsed
+    rows = list(store.get_keys(KeyRangeQuery(b"k010", b"k030",
+                                             SliceQuery()), txh))
+    assert [k for k, _ in rows] == [b"k%03d" % i for i in range(10, 30)]
+    # unordered scan: every key exactly once
+    all_rows = sorted(k for k, _ in store.get_keys(SliceQuery(), txh))
+    assert all_rows == [b"k%03d" % i for i in range(60)]
+
+
+def test_reads_survive_node_failure_with_rf2(nodes):
+    mgr = make_mgr(nodes, rf=2)
+    store = mgr.open_database("s")
+    txh = mgr.begin_transaction()
+    for i in range(40):
+        store.mutate(b"k%03d" % i, [Entry(b"c", b"%d" % i)], [], txh)
+    nodes[1].stop()
+    for i in range(40):   # every key still readable from a live replica
+        assert store.get_slice(
+            KeySliceQuery(b"k%03d" % i, SliceQuery()), txh) == \
+            [Entry(b"c", b"%d" % i)]
+    # unordered scan still sees every key exactly once
+    all_rows = sorted(k for k, _ in store.get_keys(SliceQuery(), txh))
+    assert all_rows == [b"k%03d" % i for i in range(40)]
+
+
+def test_write_consistency_all_fails_on_dead_node(nodes):
+    mgr = make_mgr(nodes, rf=2, wc="all")
+    store = mgr.open_database("s")
+    txh = mgr.begin_transaction()
+    store.mutate(b"k1", [Entry(b"c", b"1")], [], txh)
+    nodes[2].stop()
+    with pytest.raises(TemporaryBackendError):
+        for i in range(60):   # some key surely replicates to node 2
+            store.mutate(b"w%03d" % i, [Entry(b"c", b"x")], [], txh)
+
+
+def test_write_consistency_one_tolerates_dead_node(nodes):
+    mgr = make_mgr(nodes, rf=2, wc="one")
+    store = mgr.open_database("s")
+    txh = mgr.begin_transaction()
+    nodes[0].stop()
+    for i in range(60):
+        store.mutate(b"w%03d" % i, [Entry(b"c", b"x")], [], txh)
+    for i in range(60):
+        assert store.get_slice(
+            KeySliceQuery(b"w%03d" % i, SliceQuery()), txh) == \
+            [Entry(b"c", b"x")]
+
+
+def test_graph_over_cluster(nodes):
+    g = titan_tpu.open({
+        "storage.backend": "remote-cluster",
+        "storage.hostname": ",".join(hosts_of(nodes)),
+        "storage.cluster.replication-factor": 2,
+        "storage.cluster.virtual-nodes": 16,
+    })
+    try:
+        tx = g.new_transaction()
+        a = tx.add_vertex("person", name="alice")
+        b = tx.add_vertex("person", name="bob")
+        tx.add_edge(a, "knows", b)
+        tx.commit()
+        out = g.traversal().V().has("name", "alice").out("knows") \
+            .values("name").to_list()
+        assert out == ["bob"]
+        # schema listing works over the merged ordered scan
+        names = {t.name for t in g.schema.all_types()}
+        assert {"person", "name", "knows"} <= names
+    finally:
+        g.close()
+
+
+def test_graph_survives_replica_failure(nodes):
+    g = titan_tpu.open({
+        "storage.backend": "remote-cluster",
+        "storage.hostname": ",".join(hosts_of(nodes)),
+        "storage.cluster.replication-factor": 3,
+        "storage.cluster.write-consistency": "quorum",
+        "storage.cluster.virtual-nodes": 16,
+    })
+    try:
+        tx = g.new_transaction()
+        a = tx.add_vertex("person", name="alice")
+        b = tx.add_vertex("person", name="bob")
+        tx.add_edge(a, "knows", b)
+        tx.commit()
+        nodes[1].stop()
+        # reads AND writes keep working at rf=3 / quorum with one node down
+        out = g.traversal().V().has("name", "alice").out("knows") \
+            .values("name").to_list()
+        assert out == ["bob"]
+        tx = g.new_transaction()
+        c = tx.add_vertex("person", name="carol")
+        tx.add_edge(tx.vertex(a.id), "knows", c)
+        tx.commit()
+        # the first traversal auto-started the THREAD-BOUND tx, whose
+        # caches make reads repeatable (reference semantics) — refresh it
+        # to observe the commit
+        g.tx().rollback()
+        assert sorted(g.traversal().V().has("name", "alice").out("knows")
+                      .values("name").to_list()) == ["bob", "carol"]
+    finally:
+        g.close()

@@ -307,3 +307,16 @@ def test_property_meta_roundtrip_all_cardinalities(codec, schema):
         # the meta section must precede the backward relation id: a parser
         # that peels the relid first still sees the right id
         assert codec.parse(withmeta, schema).relation_id == 77
+
+
+def test_ndarray_attribute_roundtrip():
+    import numpy as np
+    for a in (np.arange(12, dtype=np.float32).reshape(3, 4),
+              np.array([1, 2, 3], dtype=np.int64),
+              np.zeros((0,), dtype=np.int8),
+              np.array([[True, False]], dtype=bool)):
+        out = DataOutput()
+        S.write_value(out, a)
+        back = S.read_value(ReadBuffer(out.getvalue()))
+        assert back.dtype == a.dtype and back.shape == a.shape
+        assert np.array_equal(back, a)

@@ -169,3 +169,27 @@ def test_bad_files(g2, tmp_path):
     pb.write_bytes(b"NOTBIN")
     with pytest.raises(titan_tpu.errors.TitanError):
         tio.read_graphbin(g2, str(pb))
+
+
+def test_ndarray_property_roundtrips_both_formats(g, g2, tmp_path):
+    import numpy as np
+    tx = g.new_transaction()
+    emb = np.arange(8, dtype=np.float32).reshape(2, 4)
+    tx.add_vertex("item", name="x", embedding=emb)
+    tx.commit()
+    # store round-trip
+    v = g.traversal().V().has("name", "x").to_list()[0]
+    got = g.tx().vertex(v.id).value("embedding")
+    assert np.array_equal(got, emb) and got.dtype == np.float32
+    # file round-trip (json then binary, chained)
+    p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.bin")
+    tio.write_graphson(g, p1)
+    tio.read_graphson(g2, p1)
+    v2 = g2.traversal().V().has("name", "x").to_list()[0]
+    assert np.array_equal(g2.tx().vertex(v2.id).value("embedding"), emb)
+    tio.write_graphbin(g2, p2)
+    g3 = titan_tpu.open("inmemory")
+    tio.read_graphbin(g3, p2)
+    v3 = g3.traversal().V().has("name", "x").to_list()[0]
+    assert np.array_equal(g3.tx().vertex(v3.id).value("embedding"), emb)
+    g3.close()

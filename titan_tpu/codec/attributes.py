@@ -277,6 +277,35 @@ class Serializer:
         self.register(AttributeHandler(
             18, frozenset, lambda o, v: self._w_list(o, sorted(v, key=repr)),
             lambda b: frozenset(self._r_list(b))))
+        # numpy arrays: the reference's primitive-array serializers
+        # (ByteArraySerializer..DoubleArraySerializer) collapse to one
+        # dtype-tagged dense codec — also the natural carrier for device-
+        # bound property vectors (embeddings) in a TPU framework
+        import numpy as _np
+
+        def _w_ndarray(o, v):
+            a = _np.ascontiguousarray(v)
+            if a.dtype.hasobject or a.dtype.names is not None:
+                # a structured/object dtype would serialize but its str()
+                # is not np.dtype()-parseable — the row would be
+                # permanently unreadable
+                raise TypeError(
+                    f"only plain numeric/bool ndarrays are storable "
+                    f"(got dtype {a.dtype})")
+            _w_str(o, a.dtype.str)
+            o.put_uvar(a.ndim)
+            for s in a.shape:
+                o.put_uvar(s)
+            _w_bytes(o, a.tobytes())
+
+        def _r_ndarray(b):
+            dtype = _np.dtype(_r_str(b))
+            shape = tuple(b.get_uvar() for _ in range(b.get_uvar()))
+            return _np.frombuffer(_r_bytes(b), dtype=dtype).reshape(shape) \
+                .copy()
+
+        self.register(AttributeHandler(19, _np.ndarray, _w_ndarray,
+                                       _r_ndarray))
 
     def register(self, h: AttributeHandler):
         if h.code in self._by_code or h.py_type in self._by_type:

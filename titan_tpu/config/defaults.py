@@ -77,6 +77,22 @@ PARALLEL_BACKEND_OPS = ConfigOption(
     STORAGE_NS, "parallel-backend-ops", "execute multi-key slices on a host pool",
     bool, True, Mutability.MASKABLE)
 
+CLUSTER_NS = ConfigNamespace(
+    STORAGE_NS, "cluster", "remote-cluster backend (sharded + replicated "
+    "storage nodes; reference role: the Cassandra/HBase cluster itself)")
+CLUSTER_REPLICATION = ConfigOption(
+    CLUSTER_NS, "replication-factor",
+    "copies of each key across storage nodes", int, 1,
+    Mutability.GLOBAL_OFFLINE, positive)
+CLUSTER_WRITE_CONSISTENCY = ConfigOption(
+    CLUSTER_NS, "write-consistency",
+    "acks required per write: all | quorum | one", str, "all",
+    Mutability.MASKABLE,
+    lambda v: v in ("all", "quorum", "one"))
+CLUSTER_VNODES = ConfigOption(
+    CLUSTER_NS, "virtual-nodes", "hash-ring virtual nodes per storage node",
+    int, 64, Mutability.GLOBAL_OFFLINE, positive)
+
 LOCK_NS = ConfigNamespace(STORAGE_NS, "lock", "distributed locking")
 LOCK_RETRIES = ConfigOption(LOCK_NS, "retries", "lock-claim write retries",
                             int, 3, Mutability.MASKABLE, positive)

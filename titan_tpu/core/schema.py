@@ -59,6 +59,10 @@ for _n, _t in [("bool", bool), ("int", int), ("float", float), ("str", str),
                ("tuple", tuple), ("set", set), ("frozenset", frozenset)]:
     register_dtype(_n, _t)
 
+import numpy as _np
+
+register_dtype("ndarray", _np.ndarray)
+
 
 @dataclass(frozen=True)
 class SchemaType:

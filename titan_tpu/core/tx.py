@@ -31,6 +31,15 @@ from titan_tpu.ids import IDType
 from titan_tpu.storage.api import Entry, KeySliceQuery, SliceQuery
 
 
+def _values_equal(a: Any, b: Any) -> bool:
+    """Property-value equality that tolerates ndarray values (whose ==
+    broadcasts instead of answering)."""
+    import numpy as np
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return bool(np.array_equal(a, b))
+    return bool(a == b)
+
+
 class GraphTransaction:
     def __init__(self, graph, read_only: bool = False,
                  log_identifier: Optional[str] = None):
@@ -207,7 +216,7 @@ class GraphTransaction:
                 self.remove_relation(p.rel)
         elif pk.cardinality is Cardinality.SET:
             for p in self.vertex_properties(v.id, [key]):
-                if p.rel.value == value:
+                if _values_equal(p.rel.value, value):
                     return p  # set semantics: already present
         rel = self._add_relation(InternalRelation(
             self.graph.id_assigner.next_relation_id(), pk.id,

@@ -69,6 +69,13 @@ def _enc(v: Any) -> Any:
         return {"@type": "decimal", "@value": str(v)}
     if isinstance(v, Geoshape):
         return {"@type": "geoshape", "@value": v.to_floats()}
+    import numpy as np
+    if isinstance(v, np.ndarray):
+        return {"@type": "ndarray",
+                "@value": [str(v.dtype), list(v.shape),
+                           base64.b64encode(
+                               np.ascontiguousarray(v).tobytes())
+                           .decode("ascii")]}
     if isinstance(v, list):
         return [_enc(x) for x in v]
     if isinstance(v, tuple):
@@ -112,6 +119,11 @@ def _dec(v: Any) -> Any:
         return _decimal.Decimal(val)
     if t == "geoshape":
         return Geoshape.from_floats(val)
+    if t == "ndarray":
+        import numpy as np
+        dtype, shape, b64 = val
+        return np.frombuffer(base64.b64decode(b64),
+                             dtype=np.dtype(dtype)).reshape(shape).copy()
     if t == "tuple":
         return tuple(_dec(x) for x in val)
     if t == "set":
@@ -183,8 +195,11 @@ def _restore_schema(graph, sd: dict) -> None:
                     mgmt.set_consistency(lb, d["consistency"])
         for d in sd.get("vertex_labels", ()):
             if schema.get_by_name(d["name"]) is None:
-                mgmt.make_vertex_label(d["name"], d.get("partitioned", False),
-                                       d.get("static", False))
+                vl = mgmt.make_vertex_label(d["name"],
+                                            d.get("partitioned", False),
+                                            d.get("static", False))
+                if d.get("ttl"):
+                    mgmt.set_ttl(vl, d["ttl"])
         for d in sd.get("indexes", ()):
             if schema.get_by_name(d["name"]) is not None:
                 continue

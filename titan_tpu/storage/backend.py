@@ -47,7 +47,10 @@ class Backend:
                 directory=config.get(d.STORAGE_DIRECTORY),
                 read_only=config.get(d.STORAGE_READONLY),
                 hostname=config.get(d.STORAGE_HOSTNAME),
-                port=config.get(d.STORAGE_PORT))
+                port=config.get(d.STORAGE_PORT),
+                replication=config.get(d.CLUSTER_REPLICATION),
+                write_consistency=config.get(d.CLUSTER_WRITE_CONSISTENCY),
+                virtual_nodes=config.get(d.CLUSTER_VNODES))
         # metrics wrapping sits directly over the raw manager so every opened
         # store is instrumented, and the expiration cache layers ABOVE it —
         # cache hits don't count as backend ops (reference: Backend.java:142-146)

@@ -1,0 +1,349 @@
+"""Sharded + replicated storage over multiple remote KCVS nodes.
+
+``storage.backend=remote-cluster`` with ``storage.hostname`` listing N
+storage nodes (``host`` or ``host:port`` entries — each an ordinary
+``python -m titan_tpu.storage.remote`` node). Plays the role the reference
+delegates to the Cassandra/HBase CLUSTER itself (reference:
+titan-cassandra AbstractCassandraStoreManager — partitioner-driven key
+placement, per-key replication, consistency levels at
+CassandraTransaction/CLevel; Titan layers locking and the id-authority
+claim protocol on top and treats the store as eventually consistent):
+
+* **Placement**: consistent-hash ring with virtual nodes (the
+  Murmur3Partitioner shape). Each key lives on its ``replication-factor``
+  distinct successor nodes.
+* **Writes**: sent to every replica; ``storage.cluster.write-consistency``
+  = ``all`` | ``quorum`` | ``one`` decides how many acks a mutation needs
+  before it succeeds (failures raise TemporaryBackendError — the standard
+  BackendOperation retry/backoff path re-applies; mutations are idempotent
+  re-applied, like the reference's assumption for C* batch replays).
+* **Reads**: replica failover in preference order.
+* **Scans**: ordered scans k-way-merge the per-node ordered streams
+  (duplicates from replication collapse adjacently); unordered scans
+  visit each node once and yield a key only from its first ALIVE replica.
+
+Like the reference on Cassandra, cross-replica consistency is
+delegated/eventual: no read-repair or anti-entropy beyond write-retry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+from typing import Iterator, Optional, Sequence
+
+from titan_tpu.errors import TemporaryBackendError
+from titan_tpu.storage.api import (Entry, EntryList, KCVMutation,
+                                   KeyColumnValueStore,
+                                   KeyColumnValueStoreManager, KeyRangeQuery,
+                                   KeySliceQuery, SliceQuery, StoreFeatures,
+                                   StoreTransaction)
+from titan_tpu.storage.remote import RemoteStoreManager
+
+
+def _token(data: bytes) -> int:
+    return int.from_bytes(hashlib.md5(data).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes; replicas(key) returns the
+    first ``rf`` DISTINCT peers clockwise from the key's token."""
+
+    def __init__(self, num_peers: int, rf: int, vnodes: int,
+                 peer_ids: Sequence[str]):
+        self.rf = min(rf, num_peers)
+        points = []
+        for p in range(num_peers):
+            for v in range(vnodes):
+                points.append((_token(f"{peer_ids[p]}#{v}".encode()), p))
+        points.sort()
+        self._tokens = [t for t, _ in points]
+        self._owners = [p for _, p in points]
+        # precomputed distinct-successor lists per ring position
+        self._succ: list[tuple[int, ...]] = []
+        m = len(points)
+        for i in range(m):
+            seen: list[int] = []
+            j = i
+            while len(seen) < self.rf and len(seen) < num_peers:
+                p = self._owners[j % m]
+                if p not in seen:
+                    seen.append(p)
+                j += 1
+            self._succ.append(tuple(seen))
+
+    def replicas(self, key: bytes) -> tuple[int, ...]:
+        t = _token(key)
+        import bisect
+        i = bisect.bisect_right(self._tokens, t) % len(self._tokens)
+        return self._succ[i]
+
+
+class ClusterStore(KeyColumnValueStore):
+    def __init__(self, manager: "ClusterStoreManager", name: str):
+        self._m = manager
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _peer_store(self, p: int):
+        return self._m.peer(p).open_database(self._name)
+
+    def get_slice(self, query: KeySliceQuery, txh,
+                  skip: frozenset = frozenset()) -> EntryList:
+        last: Optional[Exception] = None
+        for p in self._m.ring.replicas(query.key):
+            if p in skip:
+                continue
+            try:
+                return self._peer_store(p).get_slice(query, txh)
+            except TemporaryBackendError as e:
+                last = e
+                self._m.mark_down(p)
+        raise TemporaryBackendError(
+            f"no replica answered for key slice ({last})")
+
+    def get_slice_multi(self, keys: Sequence[bytes], slice_query: SliceQuery,
+                        txh) -> dict:
+        # batch per first-choice replica, failing over per-group
+        groups: dict[int, list[bytes]] = {}
+        for k in keys:
+            groups.setdefault(self._m.ring.replicas(k)[0], []).append(k)
+        out: dict[bytes, EntryList] = {}
+        for p, ks in groups.items():
+            try:
+                out.update(self._peer_store(p).get_slice_multi(ks,
+                                                               slice_query,
+                                                               txh))
+            except TemporaryBackendError:
+                self._m.mark_down(p)
+                # per-key failover, never re-dialing the peer that just
+                # failed (each retry to a dead node costs a full connect
+                # timeout)
+                for k in ks:
+                    out[k] = self.get_slice(KeySliceQuery(k, slice_query),
+                                            txh, skip=frozenset((p,)))
+        return out
+
+    def mutate(self, key: bytes, additions: Sequence[Entry],
+               deletions: Sequence[bytes], txh) -> None:
+        self._m.mutate_many(
+            {self._name: {key: KCVMutation(list(additions),
+                                           list(deletions))}}, txh)
+
+    def get_keys(self, query, txh) -> Iterator:
+        if isinstance(query, KeyRangeQuery):
+            return self._ordered_scan(query, txh)
+        return self._unordered_scan(query, txh)
+
+    def _ordered_scan(self, query: KeyRangeQuery, txh) -> Iterator:
+        """Globally ordered iteration: k-way merge of each node's ordered
+        stream; replicated duplicates arrive adjacently and collapse.
+        Peers are probed up front (get_keys is a lazy generator — a dead
+        node would otherwise only surface mid-merge); a node dying MID-scan
+        raises TemporaryBackendError for the caller's retry loop."""
+        alive = [p for p in range(self._m.num_peers) if self._m.probe(p)]
+        self._m.require_scan_coverage(alive)
+        iters = []
+        for p in alive:
+            sub = KeyRangeQuery(query.key_start, query.key_end, query.slice,
+                                None)
+            iters.append(self._peer_store(p).get_keys(sub, txh))
+
+        def keyed(it):
+            return ((k, entries) for k, entries in it)
+
+        merged = heapq.merge(*(keyed(i) for i in iters),
+                             key=lambda kv: kv[0])
+        prev = None
+        yielded = 0
+        for k, entries in merged:
+            if k == prev:
+                continue
+            prev = k
+            yield k, entries
+            yielded += 1
+            if query.key_limit is not None and yielded >= query.key_limit:
+                return
+
+    def _unordered_scan(self, query: SliceQuery, txh) -> Iterator:
+        alive = [p for p in range(self._m.num_peers) if self._m.probe(p)]
+        self._m.require_scan_coverage(alive)
+        alive_set = set(alive)
+        for p in alive:
+            for k, entries in self._peer_store(p).get_keys(query, txh):
+                owners = self._m.ring.replicas(k)
+                first_alive = next((o for o in owners if o in alive_set),
+                                   None)
+                if first_alive == p:
+                    yield k, entries
+
+
+class ClusterStoreManager(KeyColumnValueStoreManager):
+    """``storage.backend=remote-cluster``."""
+
+    def __init__(self, hosts: Sequence[str], port: int = 8283,
+                 replication: int = 1, write_consistency: str = "all",
+                 virtual_nodes: int = 64, timeout: float = 30.0):
+        if not hosts:
+            raise ValueError("remote-cluster needs storage.hostname entries")
+        self._peer_ids = []
+        self._peers: list[Optional[RemoteStoreManager]] = []
+        self._addrs = []
+        for h in hosts:
+            host, _, p = h.partition(":")
+            addr = (host or "127.0.0.1", int(p) if p else int(port or 8283))
+            self._addrs.append(addr)
+            self._peer_ids.append(f"{addr[0]}:{addr[1]}")
+            self._peers.append(None)
+        self._timeout = timeout
+        self._down: set[int] = set()
+        if write_consistency not in ("all", "quorum", "one"):
+            raise ValueError(
+                f"unknown write-consistency {write_consistency!r}")
+        self._wc = write_consistency
+        self.ring = HashRing(len(self._addrs), max(1, int(replication)),
+                             int(virtual_nodes), self._peer_ids)
+        self._stores: dict[str, ClusterStore] = {}
+        # reach at least one node up front (features: TTL = AND over
+        # reachable peers, lazily refined as others connect)
+        self._cell_ttl = True
+        ok = False
+        for p in range(self.num_peers):
+            try:
+                self.peer(p)
+                ok = True
+            except TemporaryBackendError:
+                self.mark_down(p)
+        if not ok:
+            raise TemporaryBackendError(
+                f"no cluster node reachable: {self._peer_ids}")
+
+    # -- peers ---------------------------------------------------------------
+
+    @property
+    def num_peers(self) -> int:
+        return len(self._addrs)
+
+    def peer(self, p: int) -> RemoteStoreManager:
+        mgr = self._peers[p]
+        if mgr is None:
+            host, port = self._addrs[p]
+            try:
+                mgr = RemoteStoreManager(host, port, self._timeout)
+            except Exception as e:   # connection refused etc.
+                raise TemporaryBackendError(
+                    f"storage node {self._peer_ids[p]} unreachable: {e}") \
+                    from e
+            self._peers[p] = mgr
+            self._down.discard(p)
+            self._cell_ttl = self._cell_ttl and mgr.features.cell_ttl
+        return mgr
+
+    def mark_down(self, p: int) -> None:
+        self._down.add(p)
+        self._peers[p] = None
+
+    def is_up(self, p: int) -> bool:
+        if p not in self._down:
+            return True
+        try:   # one reconnect attempt per scan/operation that asks
+            self.peer(p)
+            return True
+        except TemporaryBackendError:
+            return False
+
+    def require_scan_coverage(self, alive: Sequence[int]) -> None:
+        """A scan is complete iff every key keeps >= 1 alive replica, i.e.
+        fewer nodes are down than the replication factor — otherwise a
+        'successful' scan would silently omit the dead nodes' keys."""
+        down = self.num_peers - len(alive)
+        if down >= self.ring.rf:
+            raise TemporaryBackendError(
+                f"{down} node(s) down with replication-factor "
+                f"{self.ring.rf}: scan would be incomplete")
+
+    def probe(self, p: int) -> bool:
+        """Actively verify a peer answers (one cheap RPC); marks it down
+        on failure. Scans use this because their generators are lazy."""
+        try:
+            self.peer(p)._call("/admin", {"op": "features"})
+            return True
+        except TemporaryBackendError:
+            self.mark_down(p)
+            return False
+
+    # -- manager SPI ---------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return "remote-cluster"
+
+    @property
+    def features(self) -> StoreFeatures:
+        return StoreFeatures(ordered_scan=True, unordered_scan=True,
+                             key_ordered=True, distributed=True,
+                             batch_mutation=True, multi_query=True,
+                             key_consistent=True, persists=True,
+                             cell_ttl=self._cell_ttl)
+
+    def open_database(self, name: str) -> ClusterStore:
+        store = self._stores.get(name)
+        if store is None:
+            store = ClusterStore(self, name)
+            self._stores[name] = store
+        return store
+
+    def begin_transaction(self, config=None) -> StoreTransaction:
+        return StoreTransaction(config)
+
+    def _required_acks(self) -> int:
+        rf = self.ring.rf
+        return {"all": rf, "quorum": rf // 2 + 1, "one": 1}[self._wc]
+
+    def mutate_many(self, mutations: dict, txh) -> None:
+        # build one batched payload per peer covering its replica share
+        per_peer: dict[int, dict] = {}
+        key_owners: list[tuple[tuple[int, ...], int]] = []
+        for store_name, by_key in mutations.items():
+            for key, mut in by_key.items():
+                owners = self.ring.replicas(key)
+                key_owners.append((owners, len(owners)))
+                for p in owners:
+                    per_peer.setdefault(p, {}) \
+                        .setdefault(store_name, {})[key] = mut
+        failed: set[int] = set()
+        for p, muts in per_peer.items():
+            try:
+                self.peer(p).mutate_many(muts, txh)
+            except TemporaryBackendError:
+                failed.add(p)
+                self.mark_down(p)
+        if failed:
+            need = self._required_acks()
+            for owners, _ in key_owners:
+                acks = sum(1 for o in owners if o not in failed)
+                if acks < need:
+                    raise TemporaryBackendError(
+                        f"write got {acks}/{need} acks (down: "
+                        f"{[self._peer_ids[p] for p in sorted(failed)]})")
+
+    def close(self) -> None:
+        for mgr in self._peers:
+            if mgr is not None:
+                mgr.close()
+
+    def clear_storage(self) -> None:
+        for p in range(self.num_peers):
+            self.peer(p).clear_storage()
+
+    def exists(self) -> bool:
+        for p in range(self.num_peers):
+            try:
+                if self.peer(p).exists():
+                    return True
+            except TemporaryBackendError:
+                continue
+        return False

@@ -57,6 +57,18 @@ def _remote(hostname=None, port=None, **kw):
     return RemoteStoreManager(hostname or "127.0.0.1", int(port or 8283))
 
 
+def _remote_cluster(hostname=None, port=None, replication=None,
+                    write_consistency=None, virtual_nodes=None, **kw):
+    from titan_tpu.storage.cluster import ClusterStoreManager
+    hosts = hostname if isinstance(hostname, (list, tuple)) \
+        else ([hostname] if hostname else [])
+    return ClusterStoreManager(list(hosts), int(port or 8283),
+                               int(replication or 1),
+                               write_consistency or "all",
+                               int(virtual_nodes or 64))
+
+
 register_store("inmemory", _inmemory)
 register_store("sqlite", _sqlite)
 register_store("remote", _remote)
+register_store("remote-cluster", _remote_cluster)
