@@ -77,6 +77,22 @@ def main() -> None:
     m_traversed = int(np.count_nonzero(reachable[s2]) // 2)
     teps = m_traversed / t_bfs
 
+    # BASELINE config #1: GraphOfTheGods 2-hop Gremlin on inmemory (OLTP
+    # traversal latency; p50 of repeated runs)
+    import titan_tpu
+    from titan_tpu import example
+    g = titan_tpu.open("inmemory")
+    example.load(g)
+    twohop = lambda: g.traversal().V().out().out().count().next()  # noqa: E731
+    count2 = twohop()
+    lat = []
+    for _ in range(20):
+        t = time.time()
+        twohop()
+        lat.append(time.time() - t)
+    twohop_ms = sorted(lat)[len(lat) // 2] * 1e3
+    g.close()
+
     print(json.dumps({
         "metric": f"graph500_scale{scale}_bfs_teps",
         "value": round(teps, 1),
@@ -91,6 +107,8 @@ def main() -> None:
             "bfs_seconds": round(t_bfs, 4),
             "first_run_seconds": round(first_s, 2),
             "graphgen_seconds": round(gen_s, 2),
+            "gods_2hop_p50_ms": round(twohop_ms, 3),
+            "gods_2hop_count": int(count2),
         },
     }))
 

@@ -17,11 +17,24 @@ from titan_tpu.olap.tpu.rmat import rmat_edges
 
 scale = 23
 n = 1 << scale
-src, dst = rmat_edges(scale, 16, seed=2)
-s2 = np.concatenate([src, dst])
-d2 = np.concatenate([dst, src])
-snap = snap_mod.from_arrays(n, s2, d2)
-dst_by_src, indptr_out = snap.out_csr()
+_cache = f"/tmp/rmat{scale}_csr.npz"
+if os.path.exists(_cache):
+    z = np.load(_cache)
+    dst_by_src, indptr_out, out_degree = \
+        z["dst_by_src"], z["indptr_out"], z["out_degree"]
+
+    class _S:
+        pass
+    snap = _S()
+    snap.out_degree = out_degree
+else:
+    src, dst = rmat_edges(scale, 16, seed=2)
+    s2 = np.concatenate([src, dst])
+    d2 = np.concatenate([dst, src])
+    snap = snap_mod.from_arrays(n, s2, d2)
+    dst_by_src, indptr_out = snap.out_csr()
+    np.savez(_cache, dst_by_src=dst_by_src, indptr_out=indptr_out,
+             out_degree=snap.out_degree)
 dst_d = jnp.asarray(dst_by_src)
 ip_d = jnp.asarray(indptr_out.astype(np.int32))
 deg_d = jnp.asarray(snap.out_degree.astype(np.int32))
