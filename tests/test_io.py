@@ -193,3 +193,90 @@ def test_ndarray_property_roundtrips_both_formats(g, g2, tmp_path):
     v3 = g3.traversal().V().has("name", "x").to_list()[0]
     assert np.array_equal(g3.tx().vertex(v3.id).value("embedding"), emb)
     g3.close()
+
+
+def test_truncated_graphbin_raises_titan_error(g, g2, tmp_path):
+    """Any truncation point must surface as TitanError, not IndexError
+    (advisor finding: read_graphbin assumed a well-formed file)."""
+    _build_rich_graph(g)
+    p = tmp_path / "full.bin"
+    tio.write_graphbin(g, str(p))
+    data = p.read_bytes()
+    for cut in (len(data) // 3, len(data) // 2, len(data) - 1):
+        frag = tmp_path / f"cut{cut}.bin"
+        frag.write_bytes(data[:cut])
+        gx = titan_tpu.open("inmemory")
+        try:
+            with pytest.raises(titan_tpu.errors.TitanError):
+                tio.read_graphbin(gx, str(frag))
+        finally:
+            gx.close()
+
+
+def test_meta_property_on_loaded_property(g):
+    """Meta-properties on properties LOADED from storage (not added in the
+    same tx) rewrite the owning relation, matching the reference's
+    TitanVertexProperty.property() semantics."""
+    tx = g.new_transaction()
+    v = tx.add_vertex("person", name="ada")
+    tx.commit()
+
+    tx = g.new_transaction()
+    vv = tx.vertex(v.id)
+    [p] = [p for p in tx.vertex_properties(vv.id, ["name"])]
+    tx.add_meta_property(p, "since", 1815)
+    tx.commit()
+
+    tx = g.new_transaction()
+    [p2] = [p for p in tx.vertex_properties(v.id, ["name"])]
+    assert p2.value == "ada"
+    metas = {tx.schema_name(kid): mv for kid, mv in p2.rel.properties.items()}
+    assert metas.get("since") == 1815
+    # still exactly one 'name' property (the rewrite replaced, not added)
+    assert len(list(tx.vertex_properties(v.id, ["name"]))) == 1
+    tx.rollback()
+
+
+def test_two_meta_properties_on_same_loaded_handle(g):
+    tx = g.new_transaction()
+    v = tx.add_vertex("person", name="ada")
+    tx.commit()
+    tx = g.new_transaction()
+    [p] = list(tx.vertex_properties(v.id, ["name"]))
+    tx.add_meta_property(p, "a", 1)
+    tx.add_meta_property(p, "b", 2)
+    tx.commit()
+    tx = g.new_transaction()
+    [p2] = list(tx.vertex_properties(v.id, ["name"]))
+    metas = {tx.schema_name(k): mv for k, mv in p2.rel.properties.items()}
+    assert metas.get("a") == 1 and metas.get("b") == 2
+    tx.rollback()
+
+
+def test_corrupt_string_and_dangling_edge_raise_titan_error(g, g2, tmp_path):
+    _build_rich_graph(g)
+    p = tmp_path / "full.bin"
+    tio.write_graphbin(g, str(p))
+    data = bytearray(p.read_bytes())
+    # corrupt a label string: find 'person' bytes and break the utf-8
+    i = bytes(data).find(b"person")
+    assert i > 0
+    data[i] = 0xFF
+    bad = tmp_path / "badstr.bin"
+    bad.write_bytes(bytes(data))
+    gx = titan_tpu.open("inmemory")
+    with pytest.raises(titan_tpu.errors.TitanError):
+        tio.read_graphbin(gx, str(bad))
+    gx.close()
+    # dangling edge in GraphSON: reference a vertex id that doesn't exist
+    import json as _json
+    pj = tmp_path / "g.json"
+    tio.write_graphson(g, str(pj))
+    lines = pj.read_text().splitlines()
+    rec = _json.loads(lines[1])
+    rec["outE"] = [["knows", 99999999, {}]]
+    pj.write_text("\n".join([lines[0], _json.dumps(rec)]) + "\n")
+    gy = titan_tpu.open("inmemory")
+    with pytest.raises(titan_tpu.errors.TitanError):
+        tio.read_graphson(gy, str(pj))
+    gy.close()

@@ -228,18 +228,34 @@ class GraphTransaction:
         """Attach a meta-property to a vertex property (reference:
         TitanVertexProperty.property() — properties ON properties ride the
         owning relation's inline property map, like edge properties).
-        Only supported on properties added in this transaction: meta data
-        is serialized with the relation when it is first written."""
+
+        Meta data is serialized inline with the owning relation, so a
+        property LOADED from storage is rewritten: the old relation is
+        deleted and re-added with the merged property map (same value,
+        same key, new relation id) — matching the reference, where
+        setting a property on a loaded TitanVertexProperty also rewrites
+        the backing relation."""
         self._check_open()
         if self.read_only:
             raise SchemaViolationError("read-only transaction")
-        if p.rel.relation_id not in self._added:
-            raise SchemaViolationError(
-                "meta-properties can only be set on properties added in "
-                "the same transaction (remove the property and re-add it, "
-                "then set the meta-property before commit)")
         pk = self.schema.get_or_create_key(key, value)
-        p.rel.properties[pk.id] = self._validate_value(pk, key, value)
+        value = self._validate_value(pk, key, value)
+        if p.rel.relation_id in self._added:
+            p.rel.properties[pk.id] = value
+            return p
+        old = p.rel
+        self._check_vertex_writable(old.out_vertex_id)
+        self.remove_relation(old)
+        rel = InternalRelation(
+            self.graph.id_assigner.next_relation_id(), old.type_id,
+            RelationCategory.PROPERTY, old.out_vertex_id, value=old.value)
+        rel.properties.update(old.properties)
+        rel.properties[pk.id] = value
+        self._add_relation(rel)
+        # repoint the caller's handle at the rewritten relation so a second
+        # add_meta_property on the same handle merges instead of rewriting
+        # from the stale pre-rewrite relation (which would drop this meta)
+        p.rel = rel
         return p
 
     def _validate_value(self, pk, key: str, value: Any) -> Any:

@@ -229,11 +229,13 @@ def _restore_schema(graph, sd: dict) -> None:
 # ---------------------------------------------------------------------------
 
 
-def _vertex_records(graph) -> Iterator[tuple]:
-    """Yield (vid, label, props, out_edges) star records from a fresh
-    read-only tx. props: [(key, value, {metakey: metaval})]; out_edges:
-    [(label, in_vid, {key: value})]."""
-    tx = graph.new_transaction(read_only=True)
+def _vertex_records(graph, tx=None) -> Iterator[tuple]:
+    """Yield (vid, label, props, out_edges) star records from ``tx`` (or a
+    fresh read-only tx). props: [(key, value, {metakey: metaval})];
+    out_edges: [(label, in_vid, {key: value})]."""
+    own_tx = tx is None
+    if own_tx:
+        tx = graph.new_transaction(read_only=True)
     try:
         for v in tx.vertices():
             vid = v.id
@@ -251,7 +253,8 @@ def _vertex_records(graph) -> Iterator[tuple]:
                               e.property_map()))
             yield vid, label, props, edges
     finally:
-        tx.rollback()
+        if own_tx:
+            tx.rollback()
 
 
 def _is_declared_vlabel(graph, name: str) -> bool:
@@ -302,8 +305,13 @@ class _Loader:
 
     def add_edge(self, out_old: int, label: str, in_old: int, props) -> None:
         tx = self._ensure_tx()
-        out_v = tx.vertex_handle(self.remap[out_old])
-        in_v = tx.vertex_handle(self.remap[in_old])
+        try:
+            out_v = tx.vertex_handle(self.remap[out_old])
+            in_v = tx.vertex_handle(self.remap[in_old])
+        except KeyError as e:
+            raise TitanError(
+                f"corrupt graph file: edge references unknown vertex "
+                f"{e}") from e
         tx.add_edge(out_v, label, in_v, props or {})
         self.edges += 1
         self._tick()
@@ -404,21 +412,41 @@ class _BinReader:
         self.pos = 0
         self.ser = serializer
 
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise TitanError(
+                "corrupt graph file: truncated (wanted %d bytes at offset "
+                "%d of %d)" % (n, self.pos, len(self.data)))
+        b = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return bytes(b)
+
+    def byte(self) -> int:
+        return self._take(1)[0]
+
     def varint(self) -> int:
-        v, self.pos = varint.read_positive(self.data, self.pos)
+        try:
+            v, self.pos = varint.read_positive(self.data, self.pos)
+        except (IndexError, ValueError) as e:
+            raise TitanError(f"corrupt graph file: bad varint at offset "
+                             f"{self.pos}: {e}") from e
         return v
 
     def value(self) -> Any:
-        n = self.varint()
-        b = self.data[self.pos:self.pos + n]
-        self.pos += n
-        return self.ser.value_from_bytes(bytes(b))
+        b = self._take(self.varint())
+        try:
+            return self.ser.value_from_bytes(b)
+        except Exception as e:
+            raise TitanError(
+                f"corrupt graph file: undecodable value: {e}") from e
 
     def str_(self) -> str:
-        n = self.varint()
-        s = self.data[self.pos:self.pos + n].decode("utf-8")
-        self.pos += n
-        return s
+        b = self._take(self.varint())
+        try:
+            return b.decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise TitanError(
+                f"corrupt graph file: undecodable string: {e}") from e
 
 
 def write_graphbin(graph, path: str) -> dict:
@@ -435,31 +463,39 @@ def write_graphbin(graph, path: str) -> dict:
         f.write(blob)
         # two passes over the graph so edges stream instead of spooling
         # in memory (vertex records must all precede edge records — the
-        # loader's remap table needs every vertex before the first edge)
-        for vid, label, props, _edges in _vertex_records(graph):
-            f.write(bytes([_TAG_VERTEX]))
-            _w_varint(f, vid)
-            _w_str(f, label or "")
-            _w_varint(f, len(props))
-            for k, v, meta in props:
-                _w_str(f, k)
-                _w_value(f, ser, v)
-                _w_varint(f, len(meta))
-                for mk, mv in meta.items():
-                    _w_str(f, mk)
-                    _w_value(f, ser, mv)
-            counts["vertices"] += 1
-        for vid, _label, _props, edges in _vertex_records(graph):
-            for lb, ivid, ep in edges:
-                f.write(bytes([_TAG_EDGE]))
+        # loader's remap table needs every vertex before the first edge).
+        # BOTH passes run inside ONE read-only tx: with two separate txs a
+        # concurrent writer between the passes could add edges referencing
+        # vertices absent from the vertex section, making the snapshot
+        # unimportable.
+        tx = graph.new_transaction(read_only=True)
+        try:
+            for vid, label, props, _edges in _vertex_records(graph, tx):
+                f.write(bytes([_TAG_VERTEX]))
                 _w_varint(f, vid)
-                _w_varint(f, ivid)
-                _w_str(f, lb)
-                _w_varint(f, len(ep))
-                for k, v in ep.items():
+                _w_str(f, label or "")
+                _w_varint(f, len(props))
+                for k, v, meta in props:
                     _w_str(f, k)
                     _w_value(f, ser, v)
-                counts["edges"] += 1
+                    _w_varint(f, len(meta))
+                    for mk, mv in meta.items():
+                        _w_str(f, mk)
+                        _w_value(f, ser, mv)
+                counts["vertices"] += 1
+            for vid, _label, _props, edges in _vertex_records(graph, tx):
+                for lb, ivid, ep in edges:
+                    f.write(bytes([_TAG_EDGE]))
+                    _w_varint(f, vid)
+                    _w_varint(f, ivid)
+                    _w_str(f, lb)
+                    _w_varint(f, len(ep))
+                    for k, v in ep.items():
+                        _w_str(f, k)
+                        _w_value(f, ser, v)
+                    counts["edges"] += 1
+        finally:
+            tx.rollback()
         f.write(bytes([_TAG_END]))
     return counts
 
@@ -471,13 +507,13 @@ def read_graphbin(graph, path: str, batch_size: int = 10_000) -> dict:
         if magic != _BIN_MAGIC:
             raise TitanError(f"{path}: not a titan-tpu binary graph file")
         r = _BinReader(f, graph.serializer)
-    n = r.varint()
-    sd = json.loads(r.data[r.pos:r.pos + n].decode("utf-8"))
-    r.pos += n
+    try:
+        sd = json.loads(r._take(r.varint()).decode("utf-8"))
+    except ValueError as e:
+        raise TitanError(f"corrupt graph file: bad schema blob: {e}") from e
     _restore_schema(graph, sd)
     while True:
-        tag = r.data[r.pos]
-        r.pos += 1
+        tag = r.byte()
         if tag == _TAG_END:
             break
         if tag == _TAG_VERTEX:
