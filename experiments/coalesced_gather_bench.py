@@ -1,0 +1,43 @@
+"""Is XLA's TPU gather cost per ROW rather than per element?
+
+If yes, gathering [M/8] rows of a reshaped [E/8, 8] edge array fetches 8
+edges per row op — frontier expansion reads contiguous runs, so a row-
+gather formulation would amortize the ~100M rows/s lowering wall 8x.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def row_gather(x2d, qidx, w: int):
+    return x2d[qidx].sum()
+
+
+def main():
+    E = 1 << 28
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 1 << 20, (E,), dtype=np.int32))
+    # XLA tiles the minor dim to 128 lanes, so rows narrower than 128
+    # blow up memory 128/w x — only lane-width rows are viable
+    for w, M in ((128, 1 << 21), (128, 1 << 23), (256, 1 << 20),
+                 (512, 1 << 19)):
+        x2d = x.reshape(E // w, w)
+        qidx = jnp.asarray(
+            rng.integers(0, E // w, (M,), dtype=np.int32))
+        r = row_gather(x2d, qidx, w)
+        float(r)
+        t0 = time.time()
+        reps = 2
+        for _ in range(reps):
+            float(row_gather(x2d, qidx, w))
+        dt = (time.time() - t0) / reps
+        print(f"w={w:4d} M={M}: {dt*1e3:8.1f} ms  "
+              f"rows/s={M/dt/1e6:8.0f}M  elem/s={M*w/dt/1e6:8.0f}M")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Column-gather rate: out[:, i] = x[:, idx[i]] for x of shape [k, E/k].
+
+If a column fetch costs ~1 gather-row op, fetching k consecutive edges
+(stored transposed) costs 1/k of element-gathers — the chunk-fetch
+primitive for bottom-up BFS early-exit rounds.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=())
+def col_gather(xt, qidx):
+    return jnp.take(xt, qidx, axis=1).sum()
+
+
+def main():
+    E = 1 << 28
+    rng = np.random.default_rng(0)
+    for k, M in ((8, 1 << 23), (8, 1 << 25), (16, 1 << 24), (32, 1 << 23)):
+        xt = jnp.asarray(
+            rng.integers(0, 1 << 20, (k, E // k), dtype=np.int32))
+        qidx = jnp.asarray(rng.integers(0, E // k, (M,), dtype=np.int32))
+        float(col_gather(xt, qidx))
+        t0 = time.time()
+        reps = 2
+        for _ in range(reps):
+            float(col_gather(xt, qidx))
+        dt = (time.time() - t0) / reps
+        print(f"k={k:3d} M={M}: {dt*1e3:8.1f} ms  cols/s={M/dt/1e6:7.0f}M  "
+              f"elem/s={M*k/dt/1e6:8.0f}M")
+
+
+if __name__ == "__main__":
+    main()

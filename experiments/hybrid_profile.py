@@ -1,0 +1,105 @@
+"""Per-step timing of the hybrid BFS at scale-23 (each np.asarray syncs)."""
+import time
+import numpy as np
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import titan_tpu.models.bfs_hybrid as H
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    from titan_tpu.olap.tpu.rmat import rmat_edges
+
+    scale, ef = 23, 16
+    src, dst = rmat_edges(scale, ef, seed=2)
+    n = 1 << scale
+    snap = snap_mod.from_arrays(n, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
+
+    # monkeypatch-level tracing: wrap the jitted fns with timers
+    import functools
+    H.frontier_bfs_hybrid(snap, source, return_device=True)  # warm compile
+
+    # traced run: re-implement driver loop inline with timers
+    g = H.build_chunked_csr(snap)
+    dstT, colstart, degc, deg = g["dstT"], g["colstart"], g["degc"], g["deg"]
+    td = H._td_step(); bu = H._bu_rounds(); ex = H._bu_exhaust()
+    buwrap = H._bu_wrap(); frontier_of = H._frontier_of()
+    all_unvis = H._all_unvisited()
+    total_chunks = g["q_total"] - 1
+    cap_n = H._next_pow2(n)
+    INF = H.INF
+
+    def pad(a):
+        if a.shape[0] < cap_n:
+            a = jnp.concatenate([a, jnp.full((cap_n - a.shape[0],), n, a.dtype)])
+        return a
+
+    t_all = time.time()
+    dist = jnp.full((n + 1,), INF, jnp.int32).at[source].set(0)
+    frontier = pad(jnp.full((1,), source, jnp.int32))
+    f_count = 1
+    m8_f = int(np.asarray(snap.out_degree[source] + 7)) // 8
+    m8_unvis = total_chunks - m8_f
+    mode = "td"; cand = None; c_count = 0; level = 0
+    while f_count > 0 and level < 100:
+        t0 = time.time()
+        use_bu = m8_f * H.ALPHA > m8_unvis and f_count > 1
+        if use_bu and mode == "td":
+            cand, c_count = all_unvis(dist, degc, n_=n)
+            cand = pad(cand); mode = "bu"
+            jax.block_until_ready(cand)
+            print(f"  lv{level}: all_unvis {time.time()-t0:.3f}s")
+        elif not use_bu:
+            mode = "td"
+        if mode == "td":
+            if m8_f == 0: break
+            if frontier is None:
+                frontier = pad(frontier_of(dist, jnp.int32(level), n_=n))
+            f_cap = min(H._next_pow2(max(f_count, 2)), cap_n)
+            p_cap = min(H._next_pow2(max(m8_f, 2)),
+                        H._next_pow2(max(total_chunks + n, 2)))
+            t1 = time.time()
+            dist, frontier, st = td(dist, frontier[:f_cap], jnp.int32(f_count),
+                jnp.int32(level), dstT, colstart, degc,
+                f_cap=f_cap, p_cap=p_cap, n_=n)
+            frontier = pad(frontier)
+            f_count, m8_f, m8_unvis, nuv = (int(x) for x in np.asarray(st))
+            print(f"  lv{level} TD f_cap={f_cap} p_cap={p_cap}: {time.time()-t1:.3f}s"
+                  f" -> nf={f_count} m8_f={m8_f} unvis={nuv}")
+        else:
+            c_count = int(c_count); active = cand; a_count = c_count
+            off = jnp.zeros(active.shape, jnp.int32); rounds = 0
+            rem_total = total_chunks
+            while a_count > 0 and rounds < H.BU_CHUNK_ROUNDS:
+                c_cap = min(H._next_pow2(max(a_count, 2)), cap_n)
+                fuse = 1 if rounds == 0 else H.BU_FUSE
+                t1 = time.time()
+                dist, active, off, stx = bu(dist, active[:c_cap], off[:c_cap],
+                    jnp.int32(a_count), jnp.int32(level), dstT, colstart, degc,
+                    c_cap=c_cap, n_=n, fuse=fuse)
+                a_count, rem_total = (int(x) for x in np.asarray(stx))
+                rounds += fuse
+                print(f"  lv{level} BU c_cap={c_cap}: {time.time()-t1:.3f}s"
+                      f" -> alive={a_count} rem8={rem_total}")
+            if a_count > 0:
+                c_cap = min(H._next_pow2(max(a_count, 2)), cap_n)
+                rem_cap = H._next_pow2(max(rem_total, 2))
+                t1 = time.time()
+                dist = ex(dist, active[:c_cap], off[:c_cap], jnp.int32(a_count),
+                    jnp.int32(level), dstT, colstart, degc,
+                    c_cap=c_cap, p_cap=rem_cap, n_=n)
+                jax.block_until_ready(dist)
+                print(f"  lv{level} EX c_cap={c_cap} p_cap={rem_cap}: {time.time()-t1:.3f}s")
+            t1 = time.time()
+            src_cap = min(H._next_pow2(max(c_count, 2)), cap_n)
+            cand, st = buwrap(dist, cand[:src_cap], jnp.int32(c_count),
+                              jnp.int32(level), degc, n_=n, src_cap=src_cap)
+            cand = pad(cand); frontier = None
+            c_count, f_count, m8_f, m8_unvis = (int(x) for x in np.asarray(st))
+            print(f"  lv{level} BU wrap: {time.time()-t1:.3f}s -> nf={f_count} "
+                  f"m8_f={m8_f}")
+        level += 1
+    print(f"TOTAL {time.time()-t_all:.3f}s levels={level}")
+
+main()

@@ -1,0 +1,64 @@
+"""Scale-23 on-device comparison: frontier_bfs (round-1 path) vs hybrid."""
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import titan_tpu.models.bfs_hybrid as H
+    from titan_tpu.models.bfs import INF, frontier_bfs
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    from titan_tpu.olap.tpu.rmat import rmat_edges
+
+    scale, ef = 23, 16
+    t0 = time.time()
+    src, dst = rmat_edges(scale, ef, seed=2)
+    n = 1 << scale
+    s2 = np.concatenate([src, dst])
+    d2 = np.concatenate([dst, src])
+    snap = snap_mod.from_arrays(n, s2, d2)
+    print(f"graphgen: {time.time()-t0:.1f}s")
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
+
+    deg_dev = None
+
+    def teps_of(dist_dev, t):
+        import jax.numpy as jnp
+        nonlocal deg_dev
+        if deg_dev is None:
+            deg_dev = jnp.asarray(snap.out_degree.astype(np.int64))
+        reach = dist_dev < INF
+        m = int((jnp.where(reach, deg_dev, 0).sum()) // 2)
+        return m / t, int(reach.sum())
+
+    # hybrid
+    t0 = time.time()
+    d_h, lv = H.frontier_bfs_hybrid(snap, source, return_device=True)
+    jax.block_until_ready(d_h)
+    print(f"hybrid first (prep+compile+run): {time.time()-t0:.1f}s, lv={lv}")
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        d_h, lv = H.frontier_bfs_hybrid(snap, source, return_device=True)
+        jax.block_until_ready(d_h)
+        times.append(time.time() - t0)
+    t_h = min(times)
+    teps, reach = teps_of(d_h, t_h)
+    print(f"hybrid: {t_h:.3f}s lv={lv} reach={reach} "
+          f"TEPS={teps/1e6:.1f}M  (times={[round(t,3) for t in times]})")
+
+    # round-1 path for comparison
+    t0 = time.time()
+    d_f, lv_f = frontier_bfs(snap, source)
+    print(f"frontier first: {time.time()-t0:.1f}s")
+    t0 = time.time()
+    d_f, lv_f = frontier_bfs(snap, source)
+    t_f = time.time() - t0
+    print(f"frontier_bfs: {t_f:.3f}s lv={lv_f} (incl. D2H readback)")
+    assert (np.asarray(d_h) == d_f).all()
+    print("MATCH")
+
+
+if __name__ == "__main__":
+    main()

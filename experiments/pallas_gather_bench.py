@@ -1,0 +1,65 @@
+"""Micro-benchmark: Mosaic sublane dynamic_gather from a VMEM-resident table.
+
+out[i, j] = tab[idx[i, j], j] — the lane-aligned table-lookup primitive
+(PERF_NOTES escape route #1). If this runs >> 100M elem/s (the XLA gather
+wall), the frontier-bit check in BFS can be done at scan speeds given a
+lane-bucketed edge layout.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def gather_kernel(tab_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take_along_axis(tab_ref[:], idx_ref[:], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("T", "BLK"))
+def run(tab, idx, T: int, BLK: int):
+    B = idx.shape[0]
+    out = pl.pallas_call(
+        gather_kernel,
+        grid=(B // BLK,),
+        in_specs=[
+            pl.BlockSpec((T, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLK, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BLK, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, 128), jnp.int32),
+    )(tab, idx)
+    return out.sum()  # scalar readback only
+
+
+def main():
+    print("devices:", jax.devices())
+    # Mosaic gather lowering requires idx block shape == table shape, so
+    # BLK == T (each grid step gathers T*128 elems from the T*128 table)
+    for T, B, BLK in [(2048, 1 << 21, 2048),      # 1MB table, 268M lookups
+                      (8192, 1 << 21, 8192),      # 4MB table
+                      (16384, 1 << 21, 16384)]:   # 8MB table (scale-26 bitmap)
+        rng = np.random.default_rng(0)
+        tab = jnp.asarray(rng.integers(0, 100, (T, 128), dtype=np.int32))
+        idx = jnp.asarray(rng.integers(0, T, (B, 128), dtype=np.int32))
+        r = run(tab, idx, T, BLK)
+        float(r)  # sync
+        reps = 3
+        t0 = time.time()
+        for _ in range(reps):
+            r = run(tab, idx, T, BLK)
+            float(r)
+        dt = (time.time() - t0) / reps
+        n_elem = B * 128
+        print(f"T={T} B={B} BLK={BLK}: {dt*1e3:.1f} ms "
+              f"= {n_elem/dt/1e9:.2f} G elem/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,432 @@
+"""Direction-optimizing (top-down/bottom-up) frontier BFS on TPU.
+
+The reference executes BFS-style traversals by scanning every row through a
+vertex-program superstep (FulgoraGraphComputer.java:151-189); the TPU cost
+model is entirely different: XLA lowers *random* single-element gathers and
+scatters at a flat ~100M elem/s (PERF_NOTES.md), while *coalesced* fetches —
+columns of a [8, E/8] array (~60M cols/s = 8 edges each) and 128-wide rows
+(~10G elem/s) — are 5-50x cheaper. So the kernel design goal is: pay at
+most ONE random-access op per *examined* edge, and use direction
+optimization (Beamer et al., SC'12) to cut examined edges ~5-10x below E.
+
+Layout: the out-CSR is stored transposed and 8-aligned —
+``dstT[j, q] = neighbor j of chunk q`` with every vertex's edge segment
+padded to a multiple of 8 columns (pad = ``n+1``, out of range for the
+[n+1]-sized state arrays: pad scatters drop, pad gathers clamp to the
+never-written ``dist[n]``).
+
+SYMMETRIC GRAPHS ONLY: bottom-up treats a vertex's out-neighbors as its
+potential parents, which holds iff every edge has its reverse present
+(Graph500 BFS runs on the symmetrized graph). For directed graphs use
+``titan_tpu.models.bfs`` or symmetrize first. Fetching a
+chunk of 8 consecutive edges is then ONE aligned column gather.
+
+* Top-down level: enumerate (frontier vertex, chunk) pairs with the
+  delta-scatter+cumsum trick, column-gather all chunks, scatter-min
+  ``dist[nbr] = level+1``. Random cost: 1 scatter per frontier edge
+  (+ pad slop into the sink row).
+* Bottom-up level: keep a compacted candidate list (unvisited, deg>0).
+  Each round fetches the next 8-edge chunk per candidate (1 column
+  gather) and tests ``dist[parent] == level`` (8 random gathers); found
+  candidates drop out — the early exit that makes bottom-up cheap on
+  power-law graphs. Candidates surviving many rounds (rare: hubs with no
+  frontier parent, small non-giant components) finish in one exhaustive
+  masked sweep so a 100k-degree vertex never drives 10k host rounds.
+
+The host drives levels/rounds with ONE small stats readback per step
+(~95ms tunnel sync); all graph state stays on device, and the returned
+``dist`` is a device array (a full readback costs ~20s at scale 26 over
+the tunnel — callers that need numpy convert explicitly).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from titan_tpu.models.bfs import INF, _next_pow2
+
+# mode-switch thresholds (Beamer-style, tuned on v5e):
+# td->bu when the frontier's (chunked) edge mass exceeds 1/ALPHA of the
+# remaining unvisited edge mass; bu->td when the next frontier's edge mass
+# falls back below it. The random-op cost ratio scatter:gather is ~1:1 so
+# the classic edge-mass comparison carries over directly.
+ALPHA = 8.0
+# after this many 8-edge chunks checked per candidate, survivors go to the
+# exhaustive sweep
+BU_CHUNK_ROUNDS = 8
+# fused device rounds per host step (readbacks are ~95ms each)
+BU_FUSE = 4
+
+
+def build_chunked_csr(snap):
+    """Host-side (cached): transposed 8-aligned out-CSR device arrays.
+
+    Returns dict with ``dstT`` [8, Q] int32 (pad = n+1, see module doc),
+    ``colstart`` [n+1] int32 (first column of each vertex), ``degc``
+    [n+1] int32 (chunk count; 0 for the sink), ``deg`` [n+1] int32, all
+    on device.
+    """
+    import jax.numpy as jnp
+
+    cached = getattr(snap, "_hybrid_csr", None)
+    if cached is not None:
+        return cached
+    n = snap.n
+    dst_by_src, indptr_out = snap.out_csr()
+    deg = snap.out_degree.astype(np.int64)
+    degc = -(-deg // 8)
+    colstart = np.zeros(n + 1, np.int64)
+    np.cumsum(degc, out=colstart[1:])
+    q_total = int(colstart[-1]) + 1          # +1 all-pad column for the sink
+    if q_total * 8 >= (1 << 31):
+        raise NotImplementedError(
+            "chunked CSR uses int32 edge indices; shard below 2^31 edges")
+    # pad = n+1: OUT of range for dist[0..n], so pad-lane scatters are
+    # dropped and pad-lane gathers clamp to dist[n], which is never
+    # written and stays INF (writing the in-range sink n instead would
+    # leak level values into later bottom-up hit tests)
+    flat = np.full(q_total * 8, n + 1, np.int32)
+    # positions of each edge in the 8-aligned layout: vertex v's edge k
+    # lands at colstart[v]*8 + k
+    starts8 = colstart[:n] * 8
+    pos = np.repeat(starts8 - indptr_out[:n], deg[:n]) \
+        + np.arange(len(dst_by_src), dtype=np.int64)
+    flat[pos] = dst_by_src
+    dstT = np.ascontiguousarray(flat.reshape(q_total, 8).T)
+    out = {
+        "dstT": jnp.asarray(dstT),
+        "colstart": jnp.asarray(colstart.astype(np.int32)),
+        "degc": jnp.asarray(np.concatenate(
+            [degc, [0]]).astype(np.int32)),
+        "deg": jnp.asarray(np.concatenate(
+            [deg, [0]]).astype(np.int32)),
+        "q_total": q_total,
+        "n": n,
+    }
+    snap._hybrid_csr = out
+    return out
+
+
+# --------------------------------------------------------------------------
+# jitted level steps (module-level so (cap) buckets compile once per process)
+# --------------------------------------------------------------------------
+
+_JITS = {}
+
+
+def _get(name, builder):
+    fn = _JITS.get(name)
+    if fn is None:
+        fn = builder()
+        _JITS[name] = fn
+    return fn
+
+
+def _td_step():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("f_cap", "p_cap", "n_"),
+                           donate_argnums=(0,))
+        def td(dist, frontier, f_count, level, dstT, colstart, degc,
+               f_cap: int, p_cap: int, n_: int):
+            # enumerate (frontier vertex, chunk) pairs: pair i of vertex v
+            # fetches column colstart[v] + j  (j = i - first_pair[v])
+            valid = jnp.arange(f_cap) < f_count
+            v = jnp.minimum(frontier, n_)
+            c = jnp.where(valid, degc[v], 0)
+            ends = jnp.cumsum(c)
+            starts = ends - c
+            p_total = ends[-1]
+            base = jnp.where(valid, colstart[v], 0) - starts
+            delta = jnp.diff(base, prepend=0)
+            acc = jnp.zeros((p_cap,), jnp.int32).at[starts].add(
+                delta, mode="drop")
+            j = jnp.arange(p_cap, dtype=jnp.int32)
+            cols = jnp.cumsum(acc) + j
+            q_pad = dstT.shape[1] - 1            # all-sink column
+            cols = jnp.where(j < p_total,
+                             jnp.clip(cols, 0, q_pad), q_pad)
+            nbr = jnp.take(dstT, cols, axis=1)   # [8, p_cap], pad = n+1
+            dist = dist.at[nbr].min(level + 1, mode="drop")
+
+            changed = dist[:n_] == level + 1
+            nf = changed.sum().astype(jnp.int32)
+            next_frontier = jnp.nonzero(
+                changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
+            m8_next = jnp.where(changed, degc[:n_], 0) \
+                .sum(dtype=jnp.int32)
+            unvis = dist[:n_] >= INF
+            m8_unvis = jnp.where(unvis, degc[:n_], 0).sum(dtype=jnp.int32)
+            n_unvis = unvis.sum().astype(jnp.int32)
+            stats = jnp.stack([nf, m8_next, m8_unvis, n_unvis]) \
+                .astype(jnp.int32)
+            return dist, next_frontier, stats
+        return td
+    return _get("td", build)
+
+
+def _bu_rounds():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("c_cap", "n_", "fuse"),
+                           donate_argnums=(0,))
+        def bu(dist, cand, off, c_count, level, dstT, colstart, degc,
+               c_cap: int, n_: int, fuse: int):
+            """``fuse`` chunk-check rounds over the active candidate list.
+
+            cand: [c_cap] vertex ids (pad n_), off: [c_cap] chunk progress.
+            Found candidates get dist=level+1 and drop out; exhausted
+            candidates (all chunks checked, no hit) drop out too.
+            """
+            q_pad = dstT.shape[1] - 1
+
+            def round_(state, _):
+                dist, cand, off, c_count = state
+                alive = jnp.arange(c_cap) < c_count
+                v = jnp.minimum(cand, n_)
+                cols = jnp.where(alive, colstart[v] + off, q_pad)
+                cols = jnp.clip(cols, 0, q_pad)
+                parents = jnp.take(dstT, cols, axis=1)   # [8, c_cap]
+                # pad lanes hold n_+1 -> gather clamps to dist[n_] = INF
+                hit = dist[parents] == level
+                found = alive & hit.any(axis=0)
+                dist = dist.at[jnp.where(found, v, n_ + 1)].set(
+                    level + 1, mode="drop")
+                surv = alive & ~found & (off + 1 < degc[v])
+                idx = jnp.nonzero(surv, size=c_cap, fill_value=c_cap - 1)[0]
+                nc = surv.sum().astype(jnp.int32)
+                keep = jnp.arange(c_cap) < nc
+                cand = jnp.where(keep, cand[idx], n_)
+                off = jnp.where(keep, off[idx] + 1, 0)
+                return (dist, cand, off, nc), None
+
+            (dist, cand, off, c_count), _ = jax.lax.scan(
+                round_, (dist, cand, off, c_count), None, length=fuse)
+            # remaining chunk mass of survivors (sizes the exhaustive sweep)
+            alive = jnp.arange(c_cap) < c_count
+            v = jnp.minimum(cand, n_)
+            rem = jnp.where(alive, jnp.maximum(degc[v] - off, 0), 0) \
+                .sum(dtype=jnp.int32)
+            return dist, cand, off, jnp.stack([c_count, rem])
+        return bu
+    return _get("bu", build)
+
+
+def _bu_exhaust():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("c_cap", "p_cap", "n_"),
+                           donate_argnums=(0,))
+        def ex(dist, cand, off, c_count, level, dstT, colstart, degc,
+               c_cap: int, p_cap: int, n_: int):
+            """One masked sweep over ALL remaining chunks of the surviving
+            candidates (rare: frontier-less hubs / small components)."""
+            valid = jnp.arange(c_cap) < c_count
+            v = jnp.minimum(cand, n_)
+            rem = jnp.where(valid, jnp.maximum(degc[v] - off, 0), 0)
+            ends = jnp.cumsum(rem)
+            starts = ends - rem
+            p_total = ends[-1]
+            base = jnp.where(valid, colstart[v] + off, 0) - starts
+            delta = jnp.diff(base, prepend=0)
+            acc = jnp.zeros((p_cap,), jnp.int32).at[starts].add(
+                delta, mode="drop")
+            j = jnp.arange(p_cap, dtype=jnp.int32)
+            cols = jnp.cumsum(acc) + j
+            q_pad = dstT.shape[1] - 1
+            cols = jnp.where(j < p_total, jnp.clip(cols, 0, q_pad), q_pad)
+            parents = jnp.take(dstT, cols, axis=1)       # [8, p_cap]
+            hit = (dist[parents] == level).any(axis=0)   # [p_cap]
+            # per-candidate any-hit: segment boundaries are `starts`; use
+            # a scatter-max of hit into candidate slots via the pair->cand
+            # mapping: owner[p] = index of the candidate owning pair p
+            owner_acc = jnp.zeros((p_cap,), jnp.int32).at[starts].add(
+                jnp.diff(jnp.arange(c_cap, dtype=jnp.int32), prepend=0),
+                mode="drop")
+            owner = jnp.cumsum(owner_acc)
+            found_per = jnp.zeros((c_cap,), jnp.int32) \
+                .at[jnp.where(j < p_total, owner, c_cap - 1)] \
+                .max(hit.astype(jnp.int32), mode="drop")
+            found = valid & (found_per > 0)
+            dist = dist.at[jnp.where(found, v, n_ + 1)].set(
+                level + 1, mode="drop")
+            return dist
+        return ex
+    return _get("ex", build)
+
+
+def _bu_wrap():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_", "src_cap"))
+        def wrap(dist, src_list, src_count, level, degc, n_: int,
+                 src_cap: int):
+            """Bottom-up level end, fused: next level's candidate list
+            (entries of ``src_list`` still unvisited) + the scalar stats
+            the mode decision needs. No n-scale nonzero — the frontier
+            LIST is only built (lazily, `_frontier_of`) when switching
+            back to top-down."""
+            valid = jnp.arange(src_cap) < src_count
+            v = jnp.minimum(src_list, n_)
+            unvis = valid & (dist[v] >= INF) & (degc[v] > 0)
+            idx = jnp.nonzero(unvis, size=src_cap, fill_value=src_cap - 1)[0]
+            nc = unvis.sum().astype(jnp.int32)
+            keep = jnp.arange(src_cap) < nc
+            out = jnp.where(keep, v[idx], n_).astype(jnp.int32)
+            changed = dist[:n_] == level + 1
+            nf = changed.sum().astype(jnp.int32)
+            m8_next = jnp.where(changed, degc[:n_], 0).sum(dtype=jnp.int32)
+            m8_unvis = jnp.where(dist[:n_] >= INF, degc[:n_], 0) \
+                .sum(dtype=jnp.int32)
+            return out, jnp.stack([nc, nf, m8_next, m8_unvis])
+        return wrap
+    return _get("bu_wrap", build)
+
+
+def _frontier_of():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_",))
+        def fr(dist, level, n_: int):
+            changed = dist[:n_] == level
+            return jnp.nonzero(
+                changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
+        return fr
+    return _get("frontier_of", build)
+
+
+def _all_unvisited():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_",))
+        def au(dist, degc, n_: int):
+            unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
+            idx = jnp.nonzero(unvis, size=n_, fill_value=n_)[0]
+            return idx.astype(jnp.int32), unvis.sum().astype(jnp.int32)
+        return au
+    return _get("all_unvis", build)
+
+
+def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
+                        return_device: bool = False):
+    """Direction-optimizing BFS. Returns (dist, levels); ``dist`` is a
+    device array over [n] (INF = unreachable) when ``return_device`` else
+    numpy (note: a numpy readback of a scale-26 dist costs ~20s through
+    the axon tunnel — benches should keep it on device)."""
+    import jax.numpy as jnp
+
+    # accept either a GraphSnapshot or a prebuilt device graph dict
+    # (titan_tpu.olap.tpu.graph500.to_device)
+    g = snap if isinstance(snap, dict) else build_chunked_csr(snap)
+    n = g["n"]
+    dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
+    td = _td_step()
+    bu = _bu_rounds()
+    ex = _bu_exhaust()
+    buwrap = _bu_wrap()
+    frontier_of = _frontier_of()
+    all_unvis = _all_unvisited()
+
+    total_chunks = int((g["q_total"] - 1))
+    cap_n = _next_pow2(max(n, 2))
+
+    def pad(a):
+        # capacity buckets are powers of two, which can exceed a list's
+        # natural length (n); pad once so every [:cap] slice is exact
+        if a.shape[0] < cap_n:
+            a = jnp.concatenate(
+                [a, jnp.full((cap_n - a.shape[0],), n, a.dtype)])
+        return a
+
+    dist = jnp.full((n + 1,), INF, jnp.int32).at[source_dense].set(0)
+    frontier = pad(jnp.full((1,), source_dense, jnp.int32))
+    f_count = 1
+    m8_f = int(np.asarray(degc[source_dense]))
+    m8_unvis = total_chunks - m8_f
+    mode = "td"
+    cand = None
+    c_count = 0
+    level = 0
+    while f_count > 0 and level < max_levels:
+        use_bu = m8_f * ALPHA > m8_unvis and f_count > 1
+        if use_bu and mode == "td":
+            cand, c_count = all_unvis(dist, degc, n_=n)
+            cand = pad(cand)
+            mode = "bu"
+        elif not use_bu:
+            mode = "td"
+
+        if mode == "td":
+            if m8_f == 0:
+                break
+            if frontier is None:      # just switched back from bottom-up
+                frontier = pad(frontier_of(dist, jnp.int32(level), n_=n))
+            f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
+            p_cap = min(_next_pow2(max(m8_f, 2)),
+                        _next_pow2(max(total_chunks + n, 2)))
+            dist, frontier, st = td(
+                dist, frontier[:f_cap], jnp.int32(f_count),
+                jnp.int32(level), dstT, colstart, degc,
+                f_cap=f_cap, p_cap=p_cap, n_=n)
+            frontier = pad(frontier)
+            f_count, m8_f, m8_unvis, _ = (int(x) for x in np.asarray(st))
+        else:
+            # bottom-up: candidates = this level's unvisited list
+            c_count = int(c_count)
+            active = cand
+            a_count = c_count
+            off = jnp.zeros(active.shape, jnp.int32)
+            rounds = 0
+            rem_total = total_chunks
+            while a_count > 0 and rounds < BU_CHUNK_ROUNDS:
+                c_cap = min(_next_pow2(max(a_count, 2)), cap_n)
+                # first call checks ONE chunk: most candidates are decided
+                # by it on power-law graphs, so later (fused) rounds run
+                # at the surviving width instead of the full level width
+                fuse = 1 if rounds == 0 else BU_FUSE
+                dist, active, off, st = bu(
+                    dist, active[:c_cap], off[:c_cap], jnp.int32(a_count),
+                    jnp.int32(level), dstT, colstart, degc,
+                    c_cap=c_cap, n_=n, fuse=fuse)
+                a_count, rem_total = (int(x) for x in np.asarray(st))
+                rounds += fuse
+            if a_count > 0:
+                # exhaustive sweep for the stragglers
+                c_cap = min(_next_pow2(max(a_count, 2)), cap_n)
+                rem_cap = _next_pow2(max(rem_total, 2))
+                dist = ex(dist, active[:c_cap], off[:c_cap],
+                          jnp.int32(a_count), jnp.int32(level), dstT,
+                          colstart, degc, c_cap=c_cap, p_cap=rem_cap,
+                          n_=n)
+            # fused level end: next candidate list + scalar stats (the
+            # frontier list is rebuilt lazily on a bu->td switch)
+            src_cap = min(_next_pow2(max(c_count, 2)), cap_n)
+            cand, st = buwrap(dist, cand[:src_cap], jnp.int32(c_count),
+                              jnp.int32(level), degc, n_=n,
+                              src_cap=src_cap)
+            cand = pad(cand)
+            frontier = None
+            c_count, f_count, m8_f, m8_unvis = \
+                (int(x) for x in np.asarray(st))
+        level += 1
+    out = dist[:n]
+    if not return_device:
+        out = np.asarray(out)
+    return out, level

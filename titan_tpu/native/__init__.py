@@ -104,8 +104,18 @@ def _bind(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
     lib.tt_csr_build.argtypes = [i32p, i32p, i64, i64, i64p, i64p, i32p, i64p]
     lib.tt_gather_i32.restype = None
     lib.tt_gather_i32.argtypes = [i32p, i64p, i64, i32p]
+    lib.tt_rmat_gen.restype = None
+    lib.tt_rmat_gen.argtypes = [i64, ctypes.c_int, ctypes.c_uint64,
+                                ctypes.c_double, ctypes.c_double,
+                                ctypes.c_double, i32p, i32p]
+    c_i32pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_int32))
+    lib.tt_sym_chunked_csr.restype = i64
+    lib.tt_sym_chunked_csr.argtypes = [i32p, i32p, i64, i64, i32p, i32p,
+                                       i64p, c_i32pp]
+    lib.tt_free.restype = None
+    lib.tt_free.argtypes = [ctypes.c_void_p]
     lib.tt_abi_version.restype = ctypes.c_int
-    if lib.tt_abi_version() != 2:
+    if lib.tt_abi_version() != 3:
         return None
     return lib
 
@@ -177,3 +187,40 @@ def gather_i32(values: np.ndarray, order: np.ndarray) -> np.ndarray:
     out = np.empty(len(order), dtype=np.int32)
     _lib.tt_gather_i32(values, order, len(order), out)
     return out
+
+
+def rmat_gen(m: int, scale: int, seed: int = 1, a: float = 0.57,
+             b: float = 0.19, c: float = 0.19
+             ) -> tuple[np.ndarray, np.ndarray]:
+    """Graph500-style R-MAT edges: (src, dst) int32[m] over 2^scale
+    vertices, with a bijective avalanche scramble of vertex ids."""
+    src = np.empty(m, dtype=np.int32)
+    dst = np.empty(m, dtype=np.int32)
+    _lib.tt_rmat_gen(m, scale, seed & 0xFFFFFFFFFFFFFFFF, a, b, c, src, dst)
+    return src, dst
+
+
+def sym_chunked_csr(src: np.ndarray, dst: np.ndarray, n: int
+                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                               np.ndarray]:
+    """Symmetrized + deduped + 8-aligned chunked CSR (see the C++ docs).
+
+    Returns (flat int32[q_total, 8] chunk-major with pad n+1,
+    colstart int64[n+1], deg int32[n] post-dedup, deg_orig int32[n]
+    pre-dedup symmetrized degrees for Graph500 TEPS accounting)."""
+    import ctypes as _ct
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    deg_orig = np.zeros(n, dtype=np.int32)
+    deg = np.zeros(n, dtype=np.int32)
+    colstart = np.zeros(n + 1, dtype=np.int64)
+    ptr = _ct.POINTER(_ct.c_int32)()
+    q_total = _lib.tt_sym_chunked_csr(src, dst, len(src), n, deg_orig, deg,
+                                      colstart, _ct.byref(ptr))
+    if q_total < 0:
+        raise MemoryError("sym_chunked_csr allocation failed")
+    try:
+        flat = np.ctypeslib.as_array(ptr, shape=(int(q_total), 8)).copy()
+    finally:
+        _lib.tt_free(ptr)
+    return flat, colstart, deg, deg_orig
