@@ -162,3 +162,112 @@ def test_graph_survives_replica_failure(nodes):
                       .values("name").to_list()) == ["bob", "carol"]
     finally:
         g.close()
+
+
+def restart(server):
+    """Revive a stopped KCVSServer on the same port with its (surviving)
+    in-memory store — the 'node comes back' scenario."""
+    return KCVSServer(server.manager, port=server.port).start()
+
+
+def test_hinted_handoff_converges_revived_replica(nodes):
+    """VERDICT item 6 / advisor finding: an acknowledged write under
+    wc=one with a replica down must reach that replica after it revives
+    (hinted handoff), not stay permanently invisible."""
+    mgr = make_mgr(nodes, rf=2, wc="one")
+    store = mgr.open_database("s")
+    txh = mgr.begin_transaction()
+    store.mutate(b"seed", [Entry(b"c", b"0")], [], txh)   # connect peers
+    # find a key and kill one of ITS replicas
+    key = b"hh-key"
+    owners = mgr.ring.replicas(key)
+    victim, survivor = owners[0], owners[1]
+    nodes[victim].stop()
+    store.mutate(key, [Entry(b"c", b"v1")], [], txh)      # acked by survivor
+    assert mgr._hints.get(victim), "expected a queued hint"
+    nodes[victim] = restart(nodes[victim])
+    assert mgr.is_up(victim)                              # replays hints
+    assert not mgr._hints.get(victim)
+    # prove the revived replica owns the data: kill the OTHER replica
+    nodes[survivor].stop()
+    got = store.get_slice(KeySliceQuery(key, SliceQuery()), txh)
+    assert got == [Entry(b"c", b"v1")]
+
+
+def test_read_repair_converges_without_hints(nodes):
+    """A fresh manager (no hint state — e.g. after a coordinator restart)
+    must converge a stale replica through read repair alone."""
+    mgr = ClusterStoreManager(hosts_of(nodes), replication=3,
+                              write_consistency="quorum", virtual_nodes=16,
+                              read_repair=1.0)
+    store = mgr.open_database("s")
+    txh = mgr.begin_transaction()
+    key = b"rr-key"
+    victim = mgr.ring.replicas(key)[0]
+    nodes[victim].stop()
+    store.mutate(key, [Entry(b"c", b"new")], [], txh)     # quorum 2/3
+    mgr.close()
+    nodes[victim] = restart(nodes[victim])
+    # brand-new coordinator: no hints survive; read triggers the repair
+    mgr2 = ClusterStoreManager(hosts_of(nodes), replication=3,
+                               write_consistency="quorum", virtual_nodes=16,
+                               read_repair=1.0)
+    store2 = mgr2.open_database("s")
+    got = store2.get_slice(KeySliceQuery(key, SliceQuery()), txh)
+    assert got == [Entry(b"c", b"new")]
+    # now the revived node must have been repaired: kill the other two
+    for p in range(3):
+        if p != victim:
+            nodes[p].stop()
+    mgr3 = ClusterStoreManager([hosts_of(nodes)[victim]], replication=1,
+                               virtual_nodes=16)
+    got2 = mgr3.open_database("s").get_slice(
+        KeySliceQuery(key, SliceQuery()), txh)
+    assert got2 == [Entry(b"c", b"new")]
+
+
+def test_tombstones_prevent_deleted_data_resurrection(nodes):
+    """A replica that missed a deletion must not resurrect the cell: the
+    tombstone is newer and wins the merge."""
+    mgr = ClusterStoreManager(hosts_of(nodes), replication=3,
+                              write_consistency="quorum", virtual_nodes=16,
+                              read_repair=1.0)
+    store = mgr.open_database("s")
+    txh = mgr.begin_transaction()
+    key = b"del-key"
+    store.mutate(key, [Entry(b"c", b"live")], [], txh)    # all replicas
+    victim = mgr.ring.replicas(key)[0]
+    nodes[victim].stop()
+    store.mutate(key, [], [b"c"], txh)                    # delete w/o victim
+    mgr.close()
+    nodes[victim] = restart(nodes[victim])                # stale live cell
+    mgr2 = ClusterStoreManager(hosts_of(nodes), replication=3,
+                               write_consistency="quorum", virtual_nodes=16,
+                               read_repair=1.0)
+    store2 = mgr2.open_database("s")
+    got = store2.get_slice(KeySliceQuery(key, SliceQuery()), txh)
+    assert got == []                                      # no resurrection
+    rows = dict(store2.get_keys(KeyRangeQuery(key, key + b"\xff",
+                                              SliceQuery()), txh))
+    assert key not in rows
+
+
+def test_key_consistent_flag_honesty():
+    """Advisor finding: key_consistent must not be advertised when
+    wc=one with rf>1 (locks/id-claims would silently lose exclusion)."""
+    servers = [KCVSServer(InMemoryStoreManager()).start() for _ in range(2)]
+    try:
+        weak = ClusterStoreManager(hosts_of(servers), replication=2,
+                                   write_consistency="one", virtual_nodes=8)
+        assert not weak.features.key_consistent
+        strong = ClusterStoreManager(hosts_of(servers), replication=2,
+                                     write_consistency="quorum",
+                                     virtual_nodes=8)
+        assert strong.features.key_consistent
+        single = ClusterStoreManager(hosts_of(servers), replication=1,
+                                     write_consistency="one",
+                                     virtual_nodes=8)
+        assert single.features.key_consistent
+    finally:
+        for s in servers:
+            s.stop()

@@ -92,6 +92,11 @@ CLUSTER_WRITE_CONSISTENCY = ConfigOption(
 CLUSTER_VNODES = ConfigOption(
     CLUSTER_NS, "virtual-nodes", "hash-ring virtual nodes per storage node",
     int, 64, Mutability.GLOBAL_OFFLINE, positive)
+CLUSTER_READ_REPAIR = ConfigOption(
+    CLUSTER_NS, "read-repair",
+    "chance per read of a full-replica merge + write-back of stale cells "
+    "under write-consistency=all (quorum/one always merge-read)",
+    float, 0.1, Mutability.MASKABLE, lambda v: 0.0 <= v <= 1.0)
 
 LOCK_NS = ConfigNamespace(STORAGE_NS, "lock", "distributed locking")
 LOCK_RETRIES = ConfigOption(LOCK_NS, "retries", "lock-claim write retries",

@@ -50,7 +50,8 @@ class Backend:
                 port=config.get(d.STORAGE_PORT),
                 replication=config.get(d.CLUSTER_REPLICATION),
                 write_consistency=config.get(d.CLUSTER_WRITE_CONSISTENCY),
-                virtual_nodes=config.get(d.CLUSTER_VNODES))
+                virtual_nodes=config.get(d.CLUSTER_VNODES),
+                read_repair=config.get(d.CLUSTER_READ_REPAIR))
         # metrics wrapping sits directly over the raw manager so every opened
         # store is instrumented, and the expiration cache layers ABOVE it —
         # cache hits don't count as backend ops (reference: Backend.java:142-146)

@@ -12,24 +12,46 @@ claim protocol on top and treats the store as eventually consistent):
 * **Placement**: consistent-hash ring with virtual nodes (the
   Murmur3Partitioner shape). Each key lives on its ``replication-factor``
   distinct successor nodes.
+* **Cells**: every stored value is a timestamped cell
+  ``[magic:1][ts:8][flag:1][expiry:8][payload]`` and deletions are
+  written as TOMBSTONE cells, so replicas can always merge
+  last-writer-wins (the Cassandra cell model). TTL'd writes carry their
+  absolute expiry so read repair re-derives the remaining TTL instead of
+  resurrecting expired cells. Reads unwrap; tombstoned/expired columns
+  are invisible.
 * **Writes**: sent to every replica; ``storage.cluster.write-consistency``
-  = ``all`` | ``quorum`` | ``one`` decides how many acks a mutation needs
-  before it succeeds (failures raise TemporaryBackendError — the standard
-  BackendOperation retry/backoff path re-applies; mutations are idempotent
-  re-applied, like the reference's assumption for C* batch replays).
-* **Reads**: replica failover in preference order.
-* **Scans**: ordered scans k-way-merge the per-node ordered streams
-  (duplicates from replication collapse adjacently); unordered scans
-  visit each node once and yield a key only from its first ALIVE replica.
+  = ``all`` | ``quorum`` | ``one`` decides how many acks a mutation needs.
+  Mutations for replicas that are down are queued as **hints** and
+  replayed when the peer comes back (hinted handoff); LWW cells make the
+  replay safe in any order.
+* **Reads**: with ``write-consistency=all`` a single alive replica is
+  authoritative (fast path), and divergence is repaired probabilistically
+  (``storage.cluster.read-repair`` chance per read). With ``quorum``/
+  ``one`` every read merges all alive replicas LWW and writes winning
+  cells back to stale replicas (**read repair**) — quorum writes + merged
+  reads preserve read-your-writes, so ``features.key_consistent`` holds
+  for ``all`` and ``quorum``; with ``one`` (rf>1) it is honestly False
+  and the locking/id-claim layers must not be pointed at it.
+* **Scans**: ordered scans k-way-merge the per-node ordered streams and
+  LWW-merge runs of the same key; unordered scans visit each node once
+  and yield a key only from its first ALIVE replica (per-replica best
+  effort, like the reference's eventually-consistent bulk scans).
 
-Like the reference on Cassandra, cross-replica consistency is
-delegated/eventual: no read-repair or anti-entropy beyond write-retry.
+Known limits (documented): tombstones are retained indefinitely (no
+gc_grace compaction yet); a column-limited slice can return fewer than
+``limit`` live columns when a tombstone superseded a fetched column
+(the classic Cassandra short-read); hint queues are bounded
+(spill converges later via read repair).
 """
 
 from __future__ import annotations
 
 import hashlib
 import heapq
+import random
+import struct
+import threading
+import time
 from typing import Iterator, Optional, Sequence
 
 from titan_tpu.errors import TemporaryBackendError
@@ -37,12 +59,38 @@ from titan_tpu.storage.api import (Entry, EntryList, KCVMutation,
                                    KeyColumnValueStore,
                                    KeyColumnValueStoreManager, KeyRangeQuery,
                                    KeySliceQuery, SliceQuery, StoreFeatures,
-                                   StoreTransaction)
+                                   StoreTransaction, TTLEntry, entry_ttl)
 from titan_tpu.storage.remote import RemoteStoreManager
+
+_LIVE = 0
+_TOMB = 1
+_MAGIC = 0xCE
+# cell = [magic:1][ts:8][flag:1][expiry:8 double epoch s, 0 = no TTL][payload]
+_HDR = struct.Struct(">BQBd")
+MAX_HINTS_PER_PEER = 50_000
 
 
 def _token(data: bytes) -> int:
     return int.from_bytes(hashlib.md5(data).digest()[:8], "big")
+
+
+def _wrap(ts: int, payload: bytes, tomb: bool = False,
+          expiry: float = 0.0) -> bytes:
+    return _HDR.pack(_MAGIC, ts, _TOMB if tomb else _LIVE, expiry) + payload
+
+
+def _unwrap(value: bytes) -> tuple[int, bool, bytes, float]:
+    """(ts, is_tombstone, payload, expiry). Values not carrying the cell
+    magic are treated as legacy live cells with ts 0 (they lose every
+    merge). NOTE: a store written by a pre-cell-format build whose raw
+    values happen to start with the magic byte would be misparsed — data
+    written through any earlier remote-cluster build is NOT supported
+    behind this backend (reload it), which is why the magic exists: it
+    protects the common case, not arbitrary bytes."""
+    if len(value) < _HDR.size or value[0] != _MAGIC:
+        return 0, False, value, 0.0
+    _, ts, flag, expiry = _HDR.unpack_from(value)
+    return ts, flag == _TOMB, value[_HDR.size:], expiry
 
 
 class HashRing:
@@ -79,6 +127,53 @@ class HashRing:
         return self._succ[i]
 
 
+def _merge_cells(rows: Sequence[tuple[int, EntryList]]
+                 ) -> tuple[dict, dict]:
+    """LWW-merge replica rows. ``rows``: [(peer, entries-with-wrapped-
+    values)]. Returns (winners: {column: (ts, tomb, payload, wrapped,
+    expiry)}, repairs: {peer: [wire entry with the winning cell]}).
+    Repair entries preserve TTL: cells carry their absolute expiry, so
+    the write-back re-derives the remaining TTL (an expired cell is
+    never repaired back to life)."""
+    now = time.time()
+    winners: dict[bytes, tuple[int, bool, bytes, bytes, float]] = {}
+    have: dict[int, dict[bytes, int]] = {}
+    for p, entries in rows:
+        mine = have.setdefault(p, {})
+        for e in entries:
+            ts, tomb, payload, expiry = _unwrap(e.value)
+            mine[e.column] = ts
+            cur = winners.get(e.column)
+            # ties break on the raw cell bytes for cross-replica determinism
+            if cur is None or (ts, e.value) > (cur[0], cur[3]):
+                winners[e.column] = (ts, tomb, payload, e.value, expiry)
+    repairs: dict[int, list] = {}
+    for p, mine in have.items():
+        stale = []
+        for col, w in winners.items():
+            if mine.get(col, -1) >= w[0]:
+                continue
+            if w[4]:                       # TTL'd cell
+                remaining = w[4] - now
+                if remaining <= 0:
+                    continue               # expired: let it die everywhere
+                stale.append(TTLEntry(col, w[3], remaining))
+            else:
+                stale.append(Entry(col, w[3]))
+        if stale:
+            repairs[p] = stale
+    return winners, repairs
+
+
+def _live_entries(winners: dict, limit: Optional[int]) -> EntryList:
+    now = time.time()
+    out = [Entry(col, w[2]) for col, w in sorted(winners.items())
+           if not w[1] and (not w[4] or w[4] > now)]
+    if limit is not None:
+        out = out[:limit]
+    return out
+
+
 class ClusterStore(KeyColumnValueStore):
     def __init__(self, manager: "ClusterStoreManager", name: str):
         self._m = manager
@@ -91,47 +186,145 @@ class ClusterStore(KeyColumnValueStore):
     def _peer_store(self, p: int):
         return self._m.peer(p).open_database(self._name)
 
-    def get_slice(self, query: KeySliceQuery, txh,
-                  skip: frozenset = frozenset()) -> EntryList:
-        last: Optional[Exception] = None
+    # -- reads ---------------------------------------------------------------
+
+    def _read_replicas(self, query: KeySliceQuery, txh,
+                       skip: frozenset = frozenset()
+                       ) -> list[tuple[int, EntryList]]:
+        rows = []
         for p in self._m.ring.replicas(query.key):
             if p in skip:
                 continue
             try:
-                return self._peer_store(p).get_slice(query, txh)
-            except TemporaryBackendError as e:
-                last = e
+                rows.append((p, self._peer_store(p).get_slice(query, txh)))
+            except TemporaryBackendError:
                 self._m.mark_down(p)
-        raise TemporaryBackendError(
-            f"no replica answered for key slice ({last})")
+        return rows
+
+    def get_slice(self, query: KeySliceQuery, txh,
+                  skip: frozenset = frozenset()) -> EntryList:
+        m = self._m
+        if m.ring.rf == 1 or (m.wc == "all" and not m.repair_roll()):
+            # fast path: any alive replica is authoritative under wc=all
+            last: Optional[Exception] = None
+            for p in m.ring.replicas(query.key):
+                if p in skip:
+                    continue
+                try:
+                    entries = self._peer_store(p).get_slice(query, txh)
+                    return self._unwrap_list(entries, query.slice.limit)
+                except TemporaryBackendError as e:
+                    last = e
+                    m.mark_down(p)
+            raise TemporaryBackendError(
+                f"no replica answered for key slice ({last})")
+        rows = self._read_replicas(query, txh, skip)
+        if not rows:
+            raise TemporaryBackendError("no replica answered for key slice")
+        if m.wc == "quorum" and len(rows) < m.required_acks():
+            raise TemporaryBackendError(
+                f"quorum read got {len(rows)}/{m.required_acks()} replicas")
+        winners, repairs = _merge_cells(rows)
+        self._apply_repairs({None: repairs}, {None: query.key}, txh)
+        return _live_entries(winners, query.slice.limit)
+
+    @staticmethod
+    def _unwrap_list(entries: EntryList, limit: Optional[int]) -> EntryList:
+        now = time.time()
+        out = []
+        for e in entries:
+            _, tomb, payload, expiry = _unwrap(e.value)
+            if not tomb and (not expiry or expiry > now):
+                out.append(Entry(e.column, payload))
+                if limit is not None and len(out) >= limit:
+                    break
+        return out
+
+    def _apply_repairs(self, repairs_by_tag: dict, key_by_tag: dict,
+                       txh) -> None:
+        """Write winning cells back to stale replicas (read repair),
+        batched per peer. Repair failures are non-fatal (the read already
+        has a correct answer)."""
+        per_peer: dict[int, dict[bytes, KCVMutation]] = {}
+        for tag, repairs in repairs_by_tag.items():
+            key = key_by_tag[tag]
+            for p, entries in repairs.items():
+                per_peer.setdefault(p, {})[key] = KCVMutation(entries, [])
+        for p, by_key in per_peer.items():
+            try:
+                self._m.peer(p).mutate_many({self._name: by_key}, txh)
+            except TemporaryBackendError:
+                self._m.mark_down(p)
 
     def get_slice_multi(self, keys: Sequence[bytes], slice_query: SliceQuery,
                         txh) -> dict:
-        # batch per first-choice replica, failing over per-group
-        groups: dict[int, list[bytes]] = {}
+        m = self._m
+        if m.ring.rf == 1 or (m.wc == "all" and not m.repair_roll()):
+            # batch per first-choice replica, failing over per-group
+            groups: dict[int, list[bytes]] = {}
+            for k in keys:
+                groups.setdefault(m.ring.replicas(k)[0], []).append(k)
+            out: dict[bytes, EntryList] = {}
+            for p, ks in groups.items():
+                try:
+                    got = self._peer_store(p).get_slice_multi(ks,
+                                                              slice_query,
+                                                              txh)
+                    out.update({k: self._unwrap_list(v, slice_query.limit)
+                                for k, v in got.items()})
+                except TemporaryBackendError:
+                    m.mark_down(p)
+                    # per-key failover, never re-dialing the peer that just
+                    # failed (each retry to a dead node costs a full
+                    # connect timeout)
+                    for k in ks:
+                        out[k] = self.get_slice(
+                            KeySliceQuery(k, slice_query), txh,
+                            skip=frozenset((p,)))
+            return out
+        # merged read: batch each alive peer's share of the keys, then
+        # LWW-merge per key and repair stale replicas in one batch per peer
+        per_peer: dict[int, list[bytes]] = {}
         for k in keys:
-            groups.setdefault(self._m.ring.replicas(k)[0], []).append(k)
-        out: dict[bytes, EntryList] = {}
-        for p, ks in groups.items():
+            for p in m.ring.replicas(k):
+                per_peer.setdefault(p, []).append(k)
+        got_by_peer: dict[int, dict] = {}
+        for p, ks in per_peer.items():
             try:
-                out.update(self._peer_store(p).get_slice_multi(ks,
-                                                               slice_query,
-                                                               txh))
+                got_by_peer[p] = self._peer_store(p).get_slice_multi(
+                    ks, slice_query, txh)
             except TemporaryBackendError:
-                self._m.mark_down(p)
-                # per-key failover, never re-dialing the peer that just
-                # failed (each retry to a dead node costs a full connect
-                # timeout)
-                for k in ks:
-                    out[k] = self.get_slice(KeySliceQuery(k, slice_query),
-                                            txh, skip=frozenset((p,)))
+                m.mark_down(p)
+        out = {}
+        repairs_by_key: dict[bytes, dict] = {}
+        for k in keys:
+            rows = [(p, got_by_peer[p].get(k, []))
+                    for p in m.ring.replicas(k) if p in got_by_peer]
+            if not rows:
+                raise TemporaryBackendError(
+                    f"no replica answered for key {k!r}")
+            if m.wc == "quorum" and len(rows) < m.required_acks():
+                raise TemporaryBackendError(
+                    f"quorum read got {len(rows)}/{m.required_acks()} "
+                    f"replicas for key {k!r}")
+            winners, repairs = _merge_cells(rows)
+            out[k] = _live_entries(winners, slice_query.limit)
+            if repairs:
+                repairs_by_key[k] = repairs
+        if repairs_by_key:
+            self._apply_repairs(repairs_by_key,
+                                {k: k for k in repairs_by_key}, txh)
         return out
+
+    # -- writes --------------------------------------------------------------
 
     def mutate(self, key: bytes, additions: Sequence[Entry],
                deletions: Sequence[bytes], txh) -> None:
         self._m.mutate_many(
             {self._name: {key: KCVMutation(list(additions),
                                            list(deletions))}}, txh)
+
+    # -- scans ---------------------------------------------------------------
 
     def get_keys(self, query, txh) -> Iterator:
         if isinstance(query, KeyRangeQuery):
@@ -140,33 +333,46 @@ class ClusterStore(KeyColumnValueStore):
 
     def _ordered_scan(self, query: KeyRangeQuery, txh) -> Iterator:
         """Globally ordered iteration: k-way merge of each node's ordered
-        stream; replicated duplicates arrive adjacently and collapse.
+        stream; runs of the same key from different replicas are
+        LWW-merged (so a stale replica can't resurrect deleted columns).
         Peers are probed up front (get_keys is a lazy generator — a dead
-        node would otherwise only surface mid-merge); a node dying MID-scan
-        raises TemporaryBackendError for the caller's retry loop."""
+        node would otherwise only surface mid-merge); a node dying
+        MID-scan raises TemporaryBackendError for the caller's retry
+        loop."""
         alive = [p for p in range(self._m.num_peers) if self._m.probe(p)]
         self._m.require_scan_coverage(alive)
         iters = []
         for p in alive:
             sub = KeyRangeQuery(query.key_start, query.key_end, query.slice,
                                 None)
-            iters.append(self._peer_store(p).get_keys(sub, txh))
+            it = self._peer_store(p).get_keys(sub, txh)
+            iters.append(((k, p, entries) for k, entries in it))
 
-        def keyed(it):
-            return ((k, entries) for k, entries in it)
-
-        merged = heapq.merge(*(keyed(i) for i in iters),
-                             key=lambda kv: kv[0])
-        prev = None
+        merged = heapq.merge(*iters, key=lambda kv: kv[0])
         yielded = 0
-        for k, entries in merged:
-            if k == prev:
-                continue
-            prev = k
-            yield k, entries
-            yielded += 1
-            if query.key_limit is not None and yielded >= query.key_limit:
-                return
+        run_key = None
+        run: list[tuple[int, EntryList]] = []
+
+        def flush():
+            winners, _ = _merge_cells(run)
+            return _live_entries(winners, query.slice.limit)
+
+        for k, p, entries in merged:
+            if k != run_key and run:
+                live = flush()
+                run = []
+                if live:
+                    yield run_key, live
+                    yielded += 1
+                    if query.key_limit is not None \
+                            and yielded >= query.key_limit:
+                        return
+            run_key = k
+            run.append((p, entries))
+        if run:
+            live = flush()
+            if live:
+                yield run_key, live
 
     def _unordered_scan(self, query: SliceQuery, txh) -> Iterator:
         alive = [p for p in range(self._m.num_peers) if self._m.probe(p)]
@@ -178,7 +384,9 @@ class ClusterStore(KeyColumnValueStore):
                 first_alive = next((o for o in owners if o in alive_set),
                                    None)
                 if first_alive == p:
-                    yield k, entries
+                    live = self._unwrap_list(entries, query.limit)
+                    if live:
+                        yield k, live
 
 
 class ClusterStoreManager(KeyColumnValueStoreManager):
@@ -186,7 +394,8 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
 
     def __init__(self, hosts: Sequence[str], port: int = 8283,
                  replication: int = 1, write_consistency: str = "all",
-                 virtual_nodes: int = 64, timeout: float = 30.0):
+                 virtual_nodes: int = 64, timeout: float = 30.0,
+                 read_repair: float = 0.1):
         if not hosts:
             raise ValueError("remote-cluster needs storage.hostname entries")
         self._peer_ids = []
@@ -203,7 +412,14 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
         if write_consistency not in ("all", "quorum", "one"):
             raise ValueError(
                 f"unknown write-consistency {write_consistency!r}")
-        self._wc = write_consistency
+        self.wc = write_consistency
+        self._read_repair = float(read_repair)
+        self._rng = random.Random(0xA57B)
+        self._ts_lock = threading.Lock()
+        self._last_ts = 0
+        self._hints: dict[int, list[tuple[str, bytes, KCVMutation]]] = {}
+        self._hints_lock = threading.Lock()
+        self._hint_overflow: set[int] = set()
         self.ring = HashRing(len(self._addrs), max(1, int(replication)),
                              int(virtual_nodes), self._peer_ids)
         self._stores: dict[str, ClusterStore] = {}
@@ -220,6 +436,23 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
         if not ok:
             raise TemporaryBackendError(
                 f"no cluster node reachable: {self._peer_ids}")
+
+    # -- cells ---------------------------------------------------------------
+
+    def next_ts(self) -> int:
+        """Monotonic cell timestamp (ns since epoch, Lamport-bumped)."""
+        with self._ts_lock:
+            ts = max(time.time_ns(), self._last_ts + 1)
+            self._last_ts = ts
+            return ts
+
+    def repair_roll(self) -> bool:
+        # a peer whose hint queue overflowed can only converge through
+        # read repair, so force merged reads until it catches up
+        if self._hint_overflow:
+            return True
+        return self._read_repair > 0 and \
+            self._rng.random() < self._read_repair
 
     # -- peers ---------------------------------------------------------------
 
@@ -240,7 +473,45 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
             self._peers[p] = mgr
             self._down.discard(p)
             self._cell_ttl = self._cell_ttl and mgr.features.cell_ttl
+            self._replay_hints(p, mgr)
         return mgr
+
+    def _replay_hints(self, p: int, mgr: RemoteStoreManager) -> None:
+        """Hinted handoff: deliver the mutations this peer missed while it
+        was down. LWW cells make replay safe in any order/interleaving."""
+        with self._hints_lock:
+            queued = self._hints.pop(p, None)
+            self._hint_overflow.discard(p)
+        if not queued:
+            return
+        muts: dict[str, dict[bytes, KCVMutation]] = {}
+        for store_name, key, mut in queued:
+            slot = muts.setdefault(store_name, {})
+            prev = slot.get(key)
+            if prev is None:
+                slot[key] = KCVMutation(list(mut.additions),
+                                        list(mut.deletions))
+            else:
+                prev.additions.extend(mut.additions)
+                prev.deletions.extend(mut.deletions)
+        try:
+            mgr.mutate_many(muts, StoreTransaction(None))
+        except TemporaryBackendError:
+            with self._hints_lock:   # re-queue, newest last
+                self._hints.setdefault(p, [])[:0] = queued
+            self._peers[p] = None
+            self._down.add(p)
+            raise
+
+    def _queue_hint(self, p: int, store_name: str, key: bytes,
+                    mut: KCVMutation) -> None:
+        with self._hints_lock:
+            q = self._hints.setdefault(p, [])
+            if len(q) >= MAX_HINTS_PER_PEER:
+                # spilled hints converge later via read repair
+                self._hint_overflow.add(p)
+                return
+            q.append((store_name, key, mut))
 
     def mark_down(self, p: int) -> None:
         self._down.add(p)
@@ -283,11 +554,17 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
 
     @property
     def features(self) -> StoreFeatures:
+        # key_consistent: wc=all -> any replica read sees every acked
+        # write; wc=quorum -> merged reads (the non-fast path) span a
+        # quorum; wc=one with rf>1 genuinely cannot guarantee
+        # read-your-writes, so the locking / id-claim layers must see
+        # False (advisor finding: silently losing mutual exclusion)
+        consistent = self.wc != "one" or self.ring.rf == 1
         return StoreFeatures(ordered_scan=True, unordered_scan=True,
                              key_ordered=True, distributed=True,
                              batch_mutation=True, multi_query=True,
-                             key_consistent=True, persists=True,
-                             cell_ttl=self._cell_ttl)
+                             key_consistent=consistent, persists=True,
+                             cell_ttl=self._cell_ttl, timestamps=True)
 
     def open_database(self, name: str) -> ClusterStore:
         store = self._stores.get(name)
@@ -299,21 +576,39 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
     def begin_transaction(self, config=None) -> StoreTransaction:
         return StoreTransaction(config)
 
-    def _required_acks(self) -> int:
+    def required_acks(self) -> int:
         rf = self.ring.rf
-        return {"all": rf, "quorum": rf // 2 + 1, "one": 1}[self._wc]
+        return {"all": rf, "quorum": rf // 2 + 1, "one": 1}[self.wc]
+
+    def _wrap_mutation(self, mut: KCVMutation, ts: int) -> KCVMutation:
+        adds = []
+        now = time.time()
+        for e in mut.additions:
+            ttl = entry_ttl(e)
+            wrapped = _wrap(ts, e.value, expiry=(now + ttl) if ttl else 0.0)
+            adds.append(TTLEntry(e.column, wrapped, ttl) if ttl
+                        else Entry(e.column, wrapped))
+        # deletions become tombstone cells so stale replicas can't
+        # resurrect them during repair/merge
+        adds.extend(Entry(col, _wrap(ts, b"", tomb=True))
+                    for col in mut.deletions)
+        return KCVMutation(adds, [])
 
     def mutate_many(self, mutations: dict, txh) -> None:
+        ts = self.next_ts()
         # build one batched payload per peer covering its replica share
         per_peer: dict[int, dict] = {}
         key_owners: list[tuple[tuple[int, ...], int]] = []
+        wrapped_by_sk: dict[tuple[str, bytes], KCVMutation] = {}
         for store_name, by_key in mutations.items():
             for key, mut in by_key.items():
                 owners = self.ring.replicas(key)
                 key_owners.append((owners, len(owners)))
+                wmut = self._wrap_mutation(mut, ts)
+                wrapped_by_sk[(store_name, key)] = wmut
                 for p in owners:
                     per_peer.setdefault(p, {}) \
-                        .setdefault(store_name, {})[key] = mut
+                        .setdefault(store_name, {})[key] = wmut
         failed: set[int] = set()
         for p, muts in per_peer.items():
             try:
@@ -321,8 +616,11 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
             except TemporaryBackendError:
                 failed.add(p)
                 self.mark_down(p)
+                for store_name, by_key in muts.items():
+                    for key, wmut in by_key.items():
+                        self._queue_hint(p, store_name, key, wmut)
         if failed:
-            need = self._required_acks()
+            need = self.required_acks()
             for owners, _ in key_owners:
                 acks = sum(1 for o in owners if o not in failed)
                 if acks < need:

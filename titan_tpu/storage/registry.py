@@ -58,14 +58,17 @@ def _remote(hostname=None, port=None, **kw):
 
 
 def _remote_cluster(hostname=None, port=None, replication=None,
-                    write_consistency=None, virtual_nodes=None, **kw):
+                    write_consistency=None, virtual_nodes=None,
+                    read_repair=None, **kw):
     from titan_tpu.storage.cluster import ClusterStoreManager
     hosts = hostname if isinstance(hostname, (list, tuple)) \
         else ([hostname] if hostname else [])
     return ClusterStoreManager(list(hosts), int(port or 8283),
                                int(replication or 1),
                                write_consistency or "all",
-                               int(virtual_nodes or 64))
+                               int(virtual_nodes or 64),
+                               read_repair=(0.1 if read_repair is None
+                                            else float(read_repair)))
 
 
 register_store("inmemory", _inmemory)
