@@ -1,11 +1,18 @@
 #!/usr/bin/env python
-"""Benchmark: Graph500-style BFS TEPS on the TPU OLAP engine.
+"""Benchmark: Graph500 BFS TEPS on the TPU OLAP engine.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is measured TEPS / 1e9 (the BASELINE.md target: >= 1B TEPS on
-Graph500 scale-26 BFS on a v5e-8; this runs single-chip at a scale sized to
-the device, so vs_baseline is the fraction of the full multi-chip target
-achieved on one chip).
+The headline is Graph500 scale-26 BFS TEPS on the attached accelerator
+(BASELINE.md row 1 targets >= 1B TEPS on a v5e-8; a single chip's share is
+125M). The graph is host-built (native C++ R-MAT + symmetrize/dedup/chunk
+CSR), disk-cached under .bench_cache/, and uploaded once; BFS runs the
+direction-optimizing hybrid kernel (models/bfs_hybrid.py) with all state
+on device and only scalar readbacks. TEPS follows the official Graph500
+definition: input edge tuples (incl. duplicates/self-loops) with both
+endpoints in the traversed component, i.e. sum of pre-dedup symmetrized
+degrees over reached vertices / 2, divided by BFS wall time.
+
+On CPU (no accelerator) a scale-16 graph keeps CI fast.
 """
 
 from __future__ import annotations
@@ -17,98 +24,109 @@ import time
 import numpy as np
 
 
+def bfs_teps(scale: int, edge_factor: int = 16, seed: int = 2,
+             reps: int = 3, sources: int = 1) -> dict:
+    import jax
+
+    from titan_tpu.models.bfs import INF
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+    from titan_tpu.olap.tpu import graph500
+
+    t0 = time.time()
+    hg = graph500.load_or_build(scale, edge_factor, seed=seed, verbose=False)
+    gen_s = time.time() - t0
+    t0 = time.time()
+    g = graph500.to_device(hg)
+    jax.block_until_ready(g["dstT"])
+    upload_s = time.time() - t0
+
+    deg = np.asarray(hg["deg"])
+    # Graph500 rule: sample sources with degree > 0
+    rng = np.random.default_rng(12345)
+    nonzero = np.flatnonzero(deg > 0)
+    srcs = [int(nonzero[rng.integers(0, len(nonzero))])
+            for _ in range(sources)]
+
+    # warm-up / compile
+    t0 = time.time()
+    dist, levels = frontier_bfs_hybrid(g, srcs[0], return_device=True)
+    jax.block_until_ready(dist)
+    first_s = time.time() - t0
+
+    deg_dev = graph500.device_degrees(np.asarray(hg["deg_orig"]))
+    per_source = []
+    for source in srcs:
+        times = []
+        for _ in range(reps):
+            t0 = time.time()
+            dist, levels = frontier_bfs_hybrid(g, source, return_device=True)
+            jax.block_until_ready(dist)
+            times.append(time.time() - t0)
+        t_bfs = min(times)
+        m2, nreach = graph500.reachable_edge_sum(
+            dist, np.asarray(hg["deg_orig"]), int(INF), deg_dev=deg_dev)
+        per_source.append({"teps": (m2 // 2) / t_bfs, "t_bfs": t_bfs,
+                           "levels": int(levels), "reach": nreach,
+                           "m_traversed": m2 // 2, "source": source})
+    # Graph500 reports the MEAN TEPS over the sampled search keys
+    rep = dict(max(per_source, key=lambda r: r["teps"]))
+    rep["teps"] = sum(r["teps"] for r in per_source) / len(per_source)
+    rep["t_bfs"] = sum(r["t_bfs"] for r in per_source) / len(per_source)
+    rep.update({"gen_s": gen_s, "upload_s": upload_s, "first_s": first_s,
+                "n": hg["n"], "e_sym_pre_dedup": hg["e_sym"],
+                "e_dedup": hg["e_dedup"], "num_sources": len(per_source)})
+    return rep
+
+
+def gods_2hop() -> tuple[float, int]:
+    """BASELINE config #1: GraphOfTheGods 2-hop Gremlin count on inmemory
+    (OLTP traversal latency, p50 of 20 runs)."""
+    import titan_tpu
+    from titan_tpu import example
+
+    g = titan_tpu.open("inmemory")
+    example.load(g)
+    two = lambda: g.traversal().V().out().out().count().next()  # noqa: E731
+    count = two()
+    lat = []
+    for _ in range(20):
+        t = time.time()
+        two()
+        lat.append(time.time() - t)
+    g.close()
+    return sorted(lat)[len(lat) // 2] * 1e3, int(count)
+
+
 def main() -> None:
     import jax
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
-    scale = int(sys.argv[1]) if len(sys.argv) > 1 else (23 if on_accel else 16)
-    edge_factor = 16
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else (26 if on_accel
+                                                       else 16)
 
-    from titan_tpu.models.bfs import INF, frontier_bfs
-    from titan_tpu.olap.tpu.rmat import rmat_edges
-    from titan_tpu.olap.tpu import snapshot as snap_mod
-
-    t0 = time.time()
-    src, dst = rmat_edges(scale, edge_factor, seed=2)
-    n = 1 << scale
-    # Graph500 BFS runs on the symmetrized graph
-    s2 = np.concatenate([src, dst])
-    d2 = np.concatenate([dst, src])
-    snap = snap_mod.from_arrays(n, s2, d2)
-    gen_s = time.time() - t0
-
-    # pick a source with out-degree > 0 (Graph500 rule)
-    deg = snap.out_degree
-    source = int(np.flatnonzero(deg > 0)[0])
-
-    # frontier-sparse BFS (O(E) total work; see PERF_NOTES.md); sharded
-    # over all chips when more than one is attached; tiled (vertex-range
-    # CSR shards, int32-safe) when the edge count overflows int32 indices
-    ndev = jax.device_count()
-    if snap.num_edges >= (1 << 31):
-        # >= 2^31 directed edges: only the tiled path is int32-safe (the
-        # mesh-sharded path still indexes the whole edge array per chip)
-        from titan_tpu.models.bfs import frontier_bfs_tiled
-        run_bfs = lambda: frontier_bfs_tiled(snap, source)  # noqa: E731
-    elif ndev > 1:
-        from titan_tpu.models.bfs import frontier_bfs_sharded
-        from titan_tpu.parallel.mesh import vertex_mesh
-        mesh = vertex_mesh(ndev)
-        run_bfs = lambda: frontier_bfs_sharded(snap, source, mesh)  # noqa: E731
-    else:
-        run_bfs = lambda: frontier_bfs(snap, source)  # noqa: E731
-
-    # warm-up / compile + converged run
-    t1 = time.time()
-    dist, iters = run_bfs()
-    first_s = time.time() - t1
-
-    # timed runs (compile cached)
-    times = []
-    for _ in range(3):
-        t2 = time.time()
-        dist, iters = run_bfs()
-        times.append(time.time() - t2)
-    t_bfs = min(times)
-
-    reachable = dist < int(INF)
-    # Graph500 TEPS: input (undirected) edges with both endpoints reachable
-    m_traversed = int(np.count_nonzero(reachable[s2]) // 2)
-    teps = m_traversed / t_bfs
-
-    # BASELINE config #1: GraphOfTheGods 2-hop Gremlin on inmemory (OLTP
-    # traversal latency; p50 of repeated runs)
-    import titan_tpu
-    from titan_tpu import example
-    g = titan_tpu.open("inmemory")
-    example.load(g)
-    twohop = lambda: g.traversal().V().out().out().count().next()  # noqa: E731
-    count2 = twohop()
-    lat = []
-    for _ in range(20):
-        t = time.time()
-        twohop()
-        lat.append(time.time() - t)
-    twohop_ms = sorted(lat)[len(lat) // 2] * 1e3
-    g.close()
+    r = bfs_teps(scale)
+    twohop_ms, count2 = gods_2hop()
 
     print(json.dumps({
         "metric": f"graph500_scale{scale}_bfs_teps",
-        "value": round(teps, 1),
+        "value": round(r["teps"], 1),
         "unit": "TEPS",
-        "vs_baseline": round(teps / 1e9, 4),
+        "vs_baseline": round(r["teps"] / 1e9, 4),
         "detail": {
             "platform": platform,
-            "n_vertices": n,
-            "n_directed_edges": int(len(s2)),
-            "bfs_supersteps": int(iters),
-            "reachable_vertices": int(np.count_nonzero(reachable)),
-            "bfs_seconds": round(t_bfs, 4),
-            "first_run_seconds": round(first_s, 2),
-            "graphgen_seconds": round(gen_s, 2),
+            "n_vertices": r["n"],
+            "m_input_sym_edges": r["e_sym_pre_dedup"],
+            "m_dedup_edges": r["e_dedup"],
+            "bfs_levels": r["levels"],
+            "reachable_vertices": r["reach"],
+            "m_traversed": r["m_traversed"],
+            "bfs_seconds": round(r["t_bfs"], 4),
+            "first_run_seconds": round(r["first_s"], 2),
+            "graph_build_seconds": round(r["gen_s"], 2),
+            "upload_seconds": round(r["upload_s"], 2),
             "gods_2hop_p50_ms": round(twohop_ms, 3),
-            "gods_2hop_count": int(count2),
+            "gods_2hop_count": count2,
         },
     }))
 

@@ -80,9 +80,9 @@ def build_chunked_csr(snap):
     colstart = np.zeros(n + 1, np.int64)
     np.cumsum(degc, out=colstart[1:])
     q_total = int(colstart[-1]) + 1          # +1 all-pad column for the sink
-    if q_total * 8 >= (1 << 31):
+    if q_total >= (1 << 31):
         raise NotImplementedError(
-            "chunked CSR uses int32 edge indices; shard below 2^31 edges")
+            "chunked CSR uses int32 COLUMN indices; shard below 2^31 chunks")
     # pad = n+1: OUT of range for dist[0..n], so pad-lane scatters are
     # dropped and pad-lane gathers clamp to dist[n], which is never
     # written and stays INF (writing the in-range sink n instead would
@@ -113,15 +113,42 @@ def build_chunked_csr(snap):
 # jitted level steps (module-level so (cap) buckets compile once per process)
 # --------------------------------------------------------------------------
 
-_JITS = {}
+from titan_tpu.utils.jitcache import jit_once as _get  # noqa: E402
 
 
-def _get(name, builder):
-    fn = _JITS.get(name)
-    if fn is None:
-        fn = builder()
-        _JITS[name] = fn
-    return fn
+def enumerate_chunk_pairs(valid, counts, colstarts, p_cap: int, q_pad: int,
+                          with_owner: bool = False):
+    """Enumerate (item, chunk) pairs with the delta-scatter+cumsum trick.
+
+    ``valid`` [f_cap] bool, ``counts`` [f_cap] chunks per item (0 where
+    invalid), ``colstarts`` [f_cap] each item's first column. Pair i of
+    item j maps to column ``colstarts[j] + i - first_pair(j)``. Returns
+    (cols [p_cap] int32 clipped to q_pad with dead pairs = q_pad,
+    p_total, owner [p_cap] = owning item slot if ``with_owner``).
+
+    Colliding starts of empty items sum their deltas, so the net base
+    offset stays right; starts at/after p_cap are DROPPED (a clamped
+    delta would corrupt the last live pair's column)."""
+    import jax.numpy as jnp
+
+    f_cap = valid.shape[0]
+    counts = jnp.where(valid, counts, 0).astype(jnp.int32)
+    ends = jnp.cumsum(counts)
+    starts = ends - counts
+    p_total = ends[-1]
+    base = jnp.where(valid, colstarts, 0) - starts
+    delta = jnp.diff(base, prepend=0)
+    acc = jnp.zeros((p_cap,), jnp.int32).at[starts].add(delta, mode="drop")
+    j = jnp.arange(p_cap, dtype=jnp.int32)
+    cols = jnp.cumsum(acc) + j
+    cols = jnp.where(j < p_total, jnp.clip(cols, 0, q_pad), q_pad)
+    if not with_owner:
+        return cols, p_total, None
+    oacc = jnp.zeros((p_cap,), jnp.int32).at[starts].add(
+        jnp.diff(jnp.arange(f_cap, dtype=jnp.int32), prepend=0),
+        mode="drop")
+    owner = jnp.clip(jnp.cumsum(oacc), 0, f_cap - 1)
+    return cols, p_total, owner
 
 
 def _td_step():
@@ -134,23 +161,10 @@ def _td_step():
                            donate_argnums=(0,))
         def td(dist, frontier, f_count, level, dstT, colstart, degc,
                f_cap: int, p_cap: int, n_: int):
-            # enumerate (frontier vertex, chunk) pairs: pair i of vertex v
-            # fetches column colstart[v] + j  (j = i - first_pair[v])
             valid = jnp.arange(f_cap) < f_count
             v = jnp.minimum(frontier, n_)
-            c = jnp.where(valid, degc[v], 0)
-            ends = jnp.cumsum(c)
-            starts = ends - c
-            p_total = ends[-1]
-            base = jnp.where(valid, colstart[v], 0) - starts
-            delta = jnp.diff(base, prepend=0)
-            acc = jnp.zeros((p_cap,), jnp.int32).at[starts].add(
-                delta, mode="drop")
-            j = jnp.arange(p_cap, dtype=jnp.int32)
-            cols = jnp.cumsum(acc) + j
-            q_pad = dstT.shape[1] - 1            # all-sink column
-            cols = jnp.where(j < p_total,
-                             jnp.clip(cols, 0, q_pad), q_pad)
+            cols, _, _ = enumerate_chunk_pairs(
+                valid, degc[v], colstart[v], p_cap, dstT.shape[1] - 1)
             nbr = jnp.take(dstT, cols, axis=1)   # [8, p_cap], pad = n+1
             dist = dist.at[nbr].min(level + 1, mode="drop")
 
@@ -234,27 +248,15 @@ def _bu_exhaust():
             candidates (rare: frontier-less hubs / small components)."""
             valid = jnp.arange(c_cap) < c_count
             v = jnp.minimum(cand, n_)
-            rem = jnp.where(valid, jnp.maximum(degc[v] - off, 0), 0)
-            ends = jnp.cumsum(rem)
-            starts = ends - rem
-            p_total = ends[-1]
-            base = jnp.where(valid, colstart[v] + off, 0) - starts
-            delta = jnp.diff(base, prepend=0)
-            acc = jnp.zeros((p_cap,), jnp.int32).at[starts].add(
-                delta, mode="drop")
-            j = jnp.arange(p_cap, dtype=jnp.int32)
-            cols = jnp.cumsum(acc) + j
-            q_pad = dstT.shape[1] - 1
-            cols = jnp.where(j < p_total, jnp.clip(cols, 0, q_pad), q_pad)
+            rem = jnp.maximum(degc[v] - off, 0)
+            cols, p_total, owner = enumerate_chunk_pairs(
+                valid, rem, colstart[v] + off, p_cap, dstT.shape[1] - 1,
+                with_owner=True)
             parents = jnp.take(dstT, cols, axis=1)       # [8, p_cap]
             hit = (dist[parents] == level).any(axis=0)   # [p_cap]
-            # per-candidate any-hit: segment boundaries are `starts`; use
-            # a scatter-max of hit into candidate slots via the pair->cand
-            # mapping: owner[p] = index of the candidate owning pair p
-            owner_acc = jnp.zeros((p_cap,), jnp.int32).at[starts].add(
-                jnp.diff(jnp.arange(c_cap, dtype=jnp.int32), prepend=0),
-                mode="drop")
-            owner = jnp.cumsum(owner_acc)
+            # per-candidate any-hit: scatter-max of hit through the
+            # pair -> candidate mapping
+            j = jnp.arange(p_cap, dtype=jnp.int32)
             found_per = jnp.zeros((c_cap,), jnp.int32) \
                 .at[jnp.where(j < p_total, owner, c_cap - 1)] \
                 .max(hit.astype(jnp.int32), mode="drop")

@@ -1,0 +1,181 @@
+"""Frontier-sparse (active-set) traversal kernels on the chunked CSR.
+
+Generalizes the top-down machinery of ``bfs_hybrid`` to value-carrying
+relaxations — the frontier-sparse analogs of the reference's OLAP
+fixtures (reference: titan-test olap/ShortestDistanceVertexProgram for
+SSSP, min-label propagation for connected components): instead of full
+edge sweeps every superstep (O(E x rounds), the FulgoraGraphComputer
+model), each round expands ONLY the vertices whose value changed in the
+previous round, which bounds total work by the relaxation count.
+
+* ``frontier_sssp`` — Bellman-Ford with an improvement frontier.
+  Edge weights are derived ON DEVICE by hashing the edge slot id
+  (uniform in [min_w, min_w+w_range)), so a scale-26 run needs no
+  second 9GB weight array; ``slot_weights_np`` reproduces them on the
+  host for verification.
+* ``frontier_wcc`` — min-label propagation with an active set; on the
+  symmetrized graph labels converge to per-component minima.
+
+Both keep all state on device with one small stats readback per round
+(axon-tunnel D2H is ~0.01 GB/s; see PERF_NOTES.md) and share the
+chunked-CSR graph dict of ``bfs_hybrid`` (GraphSnapshot or
+``graph500.to_device`` output).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from titan_tpu.models.bfs_hybrid import (build_chunked_csr,
+                                         enumerate_chunk_pairs)
+from titan_tpu.models.bfs import _next_pow2
+from titan_tpu.utils.jitcache import jit_once
+
+FINF = np.float32(3.0e38)
+IINF = np.int32(1 << 30)
+
+
+def _hash_weight_expr(slot, min_w: float, w_range: float):
+    """uniform [min_w, min_w + w_range) from an int32 edge slot id
+    (murmur-style integer mix, reproduced by slot_weights_np)."""
+    import jax.numpy as jnp
+
+    x = slot.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    u = (x & jnp.uint32(0xFFFFFF)).astype(jnp.float32) * (1.0 / (1 << 24))
+    return min_w + w_range * u
+
+
+def slot_weights_np(slots: np.ndarray, min_w: float = 0.0,
+                    w_range: float = 1.0) -> np.ndarray:
+    x = slots.astype(np.uint32)
+    x = (x ^ (x >> np.uint32(16))) * np.uint32(0x7FEB352D)
+    x = (x ^ (x >> np.uint32(15))) * np.uint32(0x846CA68B)
+    x = x ^ (x >> np.uint32(16))
+    u = (x & np.uint32(0xFFFFFF)).astype(np.float32) / np.float32(1 << 24)
+    return (min_w + w_range * u).astype(np.float32)
+
+
+def _push_step(kind: str):
+    """One frontier-push round: expand the frontier's chunks, relax
+    min(value) into neighbors, return the new frontier (= improved
+    vertices) + stats. kind: 'sssp' (float dist + hashed weights) or
+    'wcc' (int label copy)."""
+    return jit_once(f"frontier_push_{kind}", lambda: _build_push(kind))
+
+
+def _build_push(kind: str):
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit,
+                       static_argnames=("f_cap", "p_cap", "n_"),
+                       donate_argnums=(0,))
+    def push(val, frontier, f_count, dstT, colstart, degc, wparams,
+             f_cap: int, p_cap: int, n_: int):
+        valid = jnp.arange(f_cap) < f_count
+        v = jnp.minimum(frontier, n_)
+        cols, _, owner = enumerate_chunk_pairs(
+            valid, degc[v], colstart[v], p_cap, dstT.shape[1] - 1,
+            with_owner=True)
+        src_val = val[v][owner]                       # [p_cap]
+        nbr = jnp.take(dstT, cols, axis=1)            # [8, p_cap], pad n+1
+        old = val
+        if kind == "sssp":
+            lane = jnp.arange(8, dtype=jnp.int32)[:, None]
+            slot = cols[None, :] * 8 + lane
+            w = _hash_weight_expr(slot, wparams[0], wparams[1])
+            msg = src_val[None, :] + w
+        else:
+            msg = jnp.broadcast_to(src_val[None, :], nbr.shape)
+        val = old.at[nbr].min(msg, mode="drop")
+        changed = val[:n_] < old[:n_]
+        nf = changed.sum().astype(jnp.int32)
+        cap = _next_pow2(max(n_, 2))
+        next_frontier = jnp.nonzero(
+            changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
+        if cap > n_:
+            next_frontier = jnp.concatenate(
+                [next_frontier,
+                 jnp.full((cap - n_,), n_, jnp.int32)])
+        m8_next = jnp.where(changed, degc[:n_], 0).sum(dtype=jnp.int32)
+        return val, next_frontier, jnp.stack([nf, m8_next])
+
+    return push
+
+
+def _frontier_run(snap_or_graph, val0, kind: str, wparams,
+                  max_rounds: int):
+    import jax.numpy as jnp
+
+    g = snap_or_graph if isinstance(snap_or_graph, dict) \
+        else build_chunked_csr(snap_or_graph)
+    n = g["n"]
+    dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
+    total_chunks = g["q_total"] - 1
+    cap_n = _next_pow2(max(n, 2))
+    push = _push_step(kind)
+    val, frontier, f_count, m8_f = val0
+
+    wp = jnp.asarray(np.asarray(wparams, np.float32))
+    rounds = 0
+    while f_count > 0 and m8_f > 0 and rounds < max_rounds:
+        f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
+        p_cap = min(_next_pow2(max(m8_f, 2)),
+                    _next_pow2(max(total_chunks + n, 2)))
+        val, frontier, st = push(val, frontier[:f_cap],
+                                 jnp.int32(f_count), dstT, colstart, degc,
+                                 wp, f_cap=f_cap, p_cap=p_cap, n_=n)
+        f_count, m8_f = (int(x) for x in np.asarray(st))
+        rounds += 1
+    return val[:n], rounds
+
+
+def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
+                  w_range: float = 1.0, max_rounds: int = 10_000,
+                  return_device: bool = False):
+    """Bellman-Ford SSSP with an improvement frontier over hashed edge
+    weights. Returns (dist float32 [n] with FINF unreachable, rounds)."""
+    import jax.numpy as jnp
+
+    g = snap_or_graph if isinstance(snap_or_graph, dict) \
+        else build_chunked_csr(snap_or_graph)
+    n = g["n"]
+    cap_n = _next_pow2(max(n, 2))
+    val = jnp.full((n + 1,), FINF, jnp.float32).at[source_dense].set(0.0)
+    frontier = jnp.full((cap_n,), n, jnp.int32).at[0].set(source_dense)
+    m8 = int(np.asarray(g["degc"][source_dense]))
+    out, rounds = _frontier_run(g, (val, frontier, 1, m8), "sssp",
+                                (min_w, w_range), max_rounds)
+    if not return_device:
+        out = np.asarray(out)
+    return out, rounds
+
+
+def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
+                 return_device: bool = False):
+    """Min-label propagation with an active set (symmetrized graphs).
+    Returns (label int32 [n] = component minimum vertex id, rounds)."""
+    import jax.numpy as jnp
+
+    g = snap_or_graph if isinstance(snap_or_graph, dict) \
+        else build_chunked_csr(snap_or_graph)
+    n = g["n"]
+    cap_n = _next_pow2(max(n, 2))
+    # labels live in [0, n); the sink slot n stays at IINF
+    val = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
+                           jnp.full((1,), IINF, jnp.int32)])
+    frontier = jnp.concatenate(
+        [jnp.arange(n, dtype=jnp.int32),
+         jnp.full((cap_n - n,), n, jnp.int32)]) if cap_n > n \
+        else jnp.arange(cap_n, dtype=jnp.int32)
+    total_chunks = int(g["q_total"]) - 1
+    out, rounds = _frontier_run(g, (val, frontier, n, total_chunks), "wcc",
+                                (0.0, 0.0), max_rounds)
+    if not return_device:
+        out = np.asarray(out)
+    return out, rounds

@@ -59,9 +59,12 @@ def load_or_build(scale: int, edge_factor: int = 16, seed: int = 2,
     del src, dst
     t2 = time.time()
     q_total = flat.shape[0]
-    if q_total * 8 >= (1 << 31):
+    # the kernels index COLUMNS (q_total) and vertices only — never flat
+    # slot positions — so int32 safety needs q_total < 2^31, not slots;
+    # scale-26 has ~2.26B slots but only ~282M columns
+    if q_total >= (1 << 31):
         raise NotImplementedError(
-            f"chunked CSR has {q_total*8} slots >= 2^31; needs sharding")
+            f"chunked CSR has {q_total} columns >= 2^31; needs sharding")
     dstT = np.ascontiguousarray(flat.T)
     del flat
     colstart = colstart64.astype(np.int32)
@@ -106,28 +109,45 @@ def to_device(host_graph: dict) -> dict:
     }
 
 
-def reachable_edge_sum(dist_dev, deg_orig: np.ndarray, inf: int,
-                       chunk: int = 4096) -> tuple[int, int]:
-    """Graph500 TEPS numerator on device: sum of PRE-dedup degrees over
-    reachable vertices (and the reachable count). The total exceeds int32
-    and x64 is disabled, so the device produces per-chunk int32 partial
-    sums (each < 2^31) and the host adds them exactly."""
+def device_degrees(deg_orig: np.ndarray, chunk: int = 4096):
+    """Upload (once) the pre-dedup degrees padded to a chunk multiple,
+    for reachable_edge_sum."""
+    import jax.numpy as jnp
+
+    pad = (-len(deg_orig)) % chunk
+    return jnp.asarray(np.concatenate(
+        [np.asarray(deg_orig, np.int32), np.zeros(pad, np.int32)]))
+
+
+def _parts_fn():
+    import functools
+
     import jax
     import jax.numpy as jnp
 
-    n = len(deg_orig)
-    pad = (-n) % chunk
-    deg_dev = jnp.asarray(np.concatenate(
-        [deg_orig, np.zeros(pad, np.int32)]))
-
-    @jax.jit
-    def parts(dist):
-        reach = dist[:n] < inf
+    @functools.partial(jax.jit, static_argnames=("n_", "inf", "chunk"))
+    def parts(dist, deg_pad, n_: int, inf: int, chunk: int):
+        reach = dist[:n_] < inf
+        pad = (-n_) % chunk
         rp = jnp.concatenate(
             [reach, jnp.zeros((pad,), bool)]).reshape(-1, chunk)
-        dp = deg_dev.reshape(-1, chunk)
+        dp = deg_pad.reshape(-1, chunk)
         psums = jnp.where(rp, dp, 0).sum(axis=1, dtype=jnp.int32)
         return psums, reach.sum(dtype=jnp.int32)
+    return parts
 
-    psums, nreach = parts(dist_dev)
+
+def reachable_edge_sum(dist_dev, deg_orig, inf: int,
+                       chunk: int = 4096, deg_dev=None) -> tuple[int, int]:
+    """Graph500 TEPS numerator on device: sum of PRE-dedup degrees over
+    reachable vertices (and the reachable count). The total exceeds int32
+    and x64 is disabled, so the device produces per-chunk int32 partial
+    sums (each < 2^31) and the host adds them exactly. Pass ``deg_dev``
+    (from device_degrees) to amortize the upload across calls."""
+    from titan_tpu.utils.jitcache import jit_once
+    parts = jit_once("graph500_reachable_parts", _parts_fn)
+    n = len(deg_orig)
+    if deg_dev is None:
+        deg_dev = device_degrees(deg_orig, chunk)
+    psums, nreach = parts(dist_dev, deg_dev, n_=n, inf=inf, chunk=chunk)
     return int(np.asarray(psums, dtype=np.int64).sum()), int(nreach)

@@ -1,0 +1,24 @@
+"""Process-level cache for lazily built jitted functions.
+
+Kernels are built once per process and keyed by name so (a) jax is only
+imported when a kernel is first needed and (b) every call site reuses the
+same function object — defining jits per call would recompile every
+shape bucket on every run (~8s each through the axon tunnel; see
+PERF_NOTES.md).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_JITS: dict = {}
+
+
+def jit_once(key: str, builder: Callable):
+    """Return the cached jitted function for ``key``, building it with
+    ``builder()`` on first use."""
+    fn = _JITS.get(key)
+    if fn is None:
+        fn = builder()
+        _JITS[key] = fn
+    return fn
