@@ -107,3 +107,85 @@ def test_frontier_wcc_matches_union_find(seed):
     expect = np.asarray([comp_min[find(v)] for v in range(n)])
     got, rounds = F.frontier_wcc(snap)
     assert (np.asarray(got) == expect).all()
+
+
+@pytest.mark.parametrize("kind", ["sssp", "wcc"])
+def test_dense_window_mode_matches_enumeration(kind, monkeypatch):
+    """Force the dense window sweep (used at scale-26 chunk masses) and
+    check it produces the same fixpoint as the enumeration path."""
+    rng = np.random.default_rng(11)
+    n = 200
+    snap = sym_snap(rng, n, 700)
+    monkeypatch.setattr(F, "DENSE_THRESHOLD_CHUNKS", 0)
+    monkeypatch.setattr(F, "DENSE_WINDOW", 16)
+    if kind == "wcc":
+        got_dense, _ = F.frontier_wcc(snap)
+    else:
+        source = int(np.flatnonzero(snap.out_degree > 0)[0])
+        got_dense, _ = F.frontier_sssp(snap, source)
+    monkeypatch.setattr(F, "DENSE_THRESHOLD_CHUNKS", 1 << 25)
+    if kind == "wcc":
+        got_enum, _ = F.frontier_wcc(snap)
+        assert (np.asarray(got_dense) == np.asarray(got_enum)).all()
+    else:
+        got_enum, _ = F.frontier_sssp(snap, source)
+        assert np.asarray(got_dense) == pytest.approx(
+            np.asarray(got_enum), rel=1e-6)
+
+
+def test_pagerank_dense_matches_numpy_reference():
+    rng = np.random.default_rng(13)
+    n = 120
+    snap = sym_snap(rng, n, 500)
+    edges = adjacency_with_slots(snap)
+    deg = np.zeros(n)
+    for v, _, _ in edges:
+        deg[v] += 1
+    rank = np.full(n, 1.0 / n)
+    for _ in range(15):
+        acc = np.zeros(n)
+        for v, u, _ in edges:
+            acc[u] += rank[v] / deg[v]
+        rank = 0.15 / n + 0.85 * acc
+    got, iters = F.pagerank_dense(snap, iterations=15)
+    assert iters == 15
+    assert np.asarray(got) == pytest.approx(rank, rel=2e-4)
+
+
+def test_pagerank_dense_tolerance_early_exit():
+    rng = np.random.default_rng(14)
+    snap = sym_snap(rng, 80, 300)
+    _, iters = F.pagerank_dense(snap, iterations=500, tol=1e-7)
+    assert iters < 500
+
+
+def test_pagerank_windowed_no_double_count(monkeypatch):
+    """Non-divisor window sizes clamp the last window's slice start;
+    scatter-ADD must not re-count the overlap (review finding)."""
+    rng = np.random.default_rng(15)
+    snap = sym_snap(rng, 150, 600)
+    ref, _ = F.pagerank_dense(snap, iterations=8)
+    for W in (3, 7, 13):
+        monkeypatch.setattr(F, "DENSE_WINDOW", W)
+        got, _ = F.pagerank_dense(snap, iterations=8)
+        assert np.asarray(got) == pytest.approx(np.asarray(ref), rel=1e-5)
+
+
+def test_graph500_numpy_fallback(tmp_path, monkeypatch):
+    """Without the native module the pipeline builds via numpy and
+    matches the native-built cache."""
+    from titan_tpu.olap.tpu import graph500 as g5
+    from titan_tpu import native
+    ha = g5.load_or_build(9, 4, seed=6, cache_dir=str(tmp_path / "a"),
+                          verbose=False)
+    monkeypatch.setattr(native, "available", False)
+    hb = g5.load_or_build(9, 4, seed=6, cache_dir=str(tmp_path / "b"),
+                          verbose=False)
+    # same generator only when native was used for both; the numpy
+    # fallback generates with a different RNG stream, so compare
+    # structure, not content
+    assert hb["n"] == ha["n"]
+    assert hb["q_total"] > 0 and hb["e_dedup"] <= hb["e_sym"]
+    deg = np.asarray(hb["deg"])
+    colstart = np.asarray(hb["colstart"])
+    assert int(colstart[-1]) == int((-(-deg.astype(np.int64) // 8)).sum())

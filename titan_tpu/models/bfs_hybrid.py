@@ -15,11 +15,13 @@ padded to a multiple of 8 columns (pad = ``n+1``, out of range for the
 [n+1]-sized state arrays: pad scatters drop, pad gathers clamp to the
 never-written ``dist[n]``).
 
+Fetching a chunk of 8 consecutive edges is then ONE aligned column
+gather.
+
 SYMMETRIC GRAPHS ONLY: bottom-up treats a vertex's out-neighbors as its
 potential parents, which holds iff every edge has its reverse present
 (Graph500 BFS runs on the symmetrized graph). For directed graphs use
-``titan_tpu.models.bfs`` or symmetrize first. Fetching a
-chunk of 8 consecutive edges is then ONE aligned column gather.
+``titan_tpu.models.bfs`` or symmetrize first.
 
 * Top-down level: enumerate (frontier vertex, chunk) pairs with the
   delta-scatter+cumsum trick, column-gather all chunks, scatter-min
@@ -104,6 +106,12 @@ def build_chunked_csr(snap):
             [deg, [0]]).astype(np.int32)),
         "q_total": q_total,
         "n": n,
+        # host copies retained for shard slicing: reading the device
+        # arrays back would cost minutes through the axon tunnel
+        # (D2H ~0.01 GB/s; see PERF_NOTES.md)
+        "_host": {"dstT": dstT,
+                  "colstart": colstart.astype(np.int32),
+                  "degc": np.concatenate([degc, [0]]).astype(np.int32)},
     }
     snap._hybrid_csr = out
     return out
@@ -181,7 +189,7 @@ def _td_step():
                 .astype(jnp.int32)
             return dist, next_frontier, stats
         return td
-    return _get("td", build)
+    return _get("hybrid_td", build)
 
 
 def _bu_rounds():
@@ -231,7 +239,7 @@ def _bu_rounds():
                 .sum(dtype=jnp.int32)
             return dist, cand, off, jnp.stack([c_count, rem])
         return bu
-    return _get("bu", build)
+    return _get("hybrid_bu", build)
 
 
 def _bu_exhaust():
@@ -265,7 +273,7 @@ def _bu_exhaust():
                 level + 1, mode="drop")
             return dist
         return ex
-    return _get("ex", build)
+    return _get("hybrid_ex", build)
 
 
 def _bu_wrap():
@@ -295,7 +303,7 @@ def _bu_wrap():
                 .sum(dtype=jnp.int32)
             return out, jnp.stack([nc, nf, m8_next, m8_unvis])
         return wrap
-    return _get("bu_wrap", build)
+    return _get("hybrid_bu_wrap", build)
 
 
 def _frontier_of():
@@ -309,7 +317,7 @@ def _frontier_of():
             return jnp.nonzero(
                 changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
         return fr
-    return _get("frontier_of", build)
+    return _get("hybrid_frontier_of", build)
 
 
 def _all_unvisited():
@@ -323,7 +331,7 @@ def _all_unvisited():
             idx = jnp.nonzero(unvis, size=n_, fill_value=n_)[0]
             return idx.astype(jnp.int32), unvis.sum().astype(jnp.int32)
         return au
-    return _get("all_unvis", build)
+    return _get("hybrid_all_unvis", build)
 
 
 def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,

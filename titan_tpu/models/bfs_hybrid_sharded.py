@@ -1,0 +1,372 @@
+"""Multi-chip direction-optimizing BFS over a vertex-block mesh.
+
+Round 1's sharded BFS replicated the distance array and pmin-reduced all
+n elements per level (a 256MB all-reduce x levels at scale 26 — VERDICT
+weak point 5). This redesign keeps the EDGE data sharded (the arrays
+that actually dominate memory: each chip holds only its vertex block's
+8-aligned chunked out-CSR) and exchanges only SPARSE newly-found vertex
+lists over ICI:
+
+* Top-down level: every chip expands its block's share of the frontier
+  into its local dist replica, counts its discoveries, then one
+  all-gather of [D, found_cap] vertex ids (found_cap = actual per-chip
+  maximum, host-sized) merges them — communication is O(frontier), not
+  O(n). The dist array itself is replicated (n int32 = 268MB at scale
+  26: cheap memory, zero steady-state traffic), a deliberate trade
+  documented here: per-vertex *model state* in the dense engine is
+  sharded; BFS replicates dist precisely so the exchange can be sparse.
+* Bottom-up level: candidates live in their owner's block and check
+  their own in-edges (symmetric graph: the block's out-CSR), so rounds
+  are FULLY LOCAL — parents' dist==level values were settled by the
+  previous level's exchange. Only the level-end found lists are
+  gathered.
+
+The host drives levels like the single-chip hybrid (shapes bucketed to
+powers of two, two scalar readbacks per level). Per-shard edge arrays
+use LOCAL column indices, so each shard stays int32-safe as long as its
+own chunk count is < 2^31 — 8 shards of a scale-26 graph are ~35M
+columns each.
+
+Symmetric graphs only (see bfs_hybrid). Validated against the
+single-chip hybrid on an 8-device CPU mesh in tests/test_sharded_bfs.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from titan_tpu.models.bfs import INF, _next_pow2
+from titan_tpu.models.bfs_hybrid import enumerate_chunk_pairs
+from titan_tpu.utils.jitcache import jit_once
+
+ALPHA = 8.0
+BU_CHUNK_ROUNDS = 8
+BU_FUSE = 4
+
+
+def shard_chunked_csr(snap_or_graph, num_shards: int):
+    """Edge-balanced vertex-range shards of the chunked CSR, padded to
+    uniform shapes: dict with ``dstT_sh`` [D, 8, Qmax] (pad n+1),
+    ``colstart_sh`` [D, Bmax+1] LOCAL column starts, ``degc_sh``
+    [D, Bmax], ``bounds`` [D+1], ``degc`` (global, replicated) — numpy;
+    device placement happens in the runner (shard_map partitions them).
+    Cached on the source object."""
+    from titan_tpu.models.bfs_hybrid import build_chunked_csr
+
+    if isinstance(snap_or_graph, dict):
+        g = snap_or_graph
+    else:
+        g = build_chunked_csr(snap_or_graph)
+    cache = g.get("_shards")
+    if cache is not None and cache[0] == num_shards:
+        return cache[1]
+    n = g["n"]
+    q_total = g["q_total"]
+    # shard from HOST arrays only — np.asarray on the device arrays would
+    # read gigabytes back through the ~0.01 GB/s tunnel
+    host = g.get("_host", g)
+    colstart = host["colstart"]
+    dstT = host["dstT"]
+    if "degc" in host:
+        degc_all = np.asarray(host["degc"])[:n]
+    else:                      # graph500.load_or_build host dict
+        deg = np.asarray(host["deg"])
+        degc_all = (-(-deg // 8)).astype(np.int32)
+    for a in (colstart, dstT):
+        if not isinstance(a, np.ndarray):   # np.memmap passes
+            raise TypeError(
+                "shard_chunked_csr needs host (numpy) graph arrays; pass "
+                "the graph500.load_or_build dict or a GraphSnapshot, not "
+                "a to_device() result")
+    colstart = np.asarray(colstart)
+    dstT = np.asarray(dstT)
+    # edge-balanced cuts on the chunk prefix
+    total = int(colstart[n])
+    cuts = [0]
+    for k in range(1, num_shards):
+        cuts.append(int(np.searchsorted(colstart[:n + 1],
+                                        k * total / num_shards)))
+    cuts.append(n)
+    bounds = np.asarray(sorted(set(cuts)), np.int64)
+    d_eff = len(bounds) - 1
+    b_max = max(1, int((bounds[1:] - bounds[:-1]).max()))
+    q_max = max(1, max(int(colstart[bounds[d + 1]] - colstart[bounds[d]])
+                       for d in range(d_eff))) + 1   # +1 local sink col
+    dstT_sh = np.full((num_shards, 8, q_max), n + 1, np.int32)
+    colstart_sh = np.zeros((num_shards, b_max + 1), np.int32)
+    degc_sh = np.zeros((num_shards, b_max), np.int32)
+    for d in range(d_eff):
+        lo, hi = int(bounds[d]), int(bounds[d + 1])
+        c0, c1 = int(colstart[lo]), int(colstart[hi])
+        dstT_sh[d, :, :c1 - c0] = dstT[:, c0:c1]
+        local = (colstart[lo:hi + 1] - c0).astype(np.int32)
+        colstart_sh[d, :hi - lo + 1] = local
+        colstart_sh[d, hi - lo + 1:] = local[-1]
+        degc_sh[d, :hi - lo] = degc_all[lo:hi]
+    bounds_full = np.zeros(num_shards + 1, np.int64)
+    bounds_full[:len(bounds)] = bounds
+    bounds_full[len(bounds):] = n
+    out = {
+        "dstT_sh": dstT_sh, "colstart_sh": colstart_sh,
+        "degc_sh": degc_sh, "bounds": bounds_full, "n": n,
+        "b_max": b_max, "q_max": q_max, "q_total": q_total,
+        "degc": np.concatenate([degc_all, [0]]).astype(np.int32),
+        "total_chunks": total,
+    }
+    if isinstance(g, dict):
+        g["_shards"] = (num_shards, out)
+    return out
+
+
+def _td_expand():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from titan_tpu.parallel.mesh import VERTEX_AXIS
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("mesh", "f_cap", "p_cap", "n_", "b_max"))
+        def td(dist, frontier, f_count, level, dstT_sh, colstart_sh,
+               degc_sh, lo_sh, hi_sh, mesh, f_cap: int, p_cap: int,
+               n_: int, b_max: int):
+            """Local expansion: returns the per-chip updated dist and the
+            [D] per-chip newly-found counts (replicated)."""
+            def per_shard(dist, frontier, dstT_l, cs_l, degc_l, lo, hi):
+                dstT_l, cs_l, degc_l = dstT_l[0], cs_l[0], degc_l[0]
+                lo, hi = lo[0], hi[0]
+                valid = (jnp.arange(f_cap) < f_count) \
+                    & (frontier >= lo) & (frontier < hi)
+                v = jnp.clip(frontier - lo, 0, b_max - 1)
+                cols, _, _ = enumerate_chunk_pairs(
+                    valid, degc_l[v], cs_l[v], p_cap,
+                    dstT_l.shape[1] - 1)
+                nbr = jnp.take(dstT_l, cols, axis=1)
+                newd = dist.at[nbr].min(level + 1, mode="drop")
+                newly = (newd[:n_] == level + 1) & (dist[:n_] > level + 1)
+                cnt = newly.sum().astype(jnp.int32)
+                counts = jax.lax.all_gather(cnt, VERTEX_AXIS)
+                return newd[None], counts
+
+            return jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P(), P(), P(VERTEX_AXIS, None, None),
+                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
+                          P(VERTEX_AXIS), P(VERTEX_AXIS)),
+                out_specs=(P(VERTEX_AXIS, None), P()),
+                check_vma=False,
+            )(dist, frontier, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
+        return td
+    return jit_once("shbfs_td", build)
+
+
+def _exchange():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from titan_tpu.parallel.mesh import VERTEX_AXIS
+
+        @functools.partial(
+            jax.jit, static_argnames=("mesh", "found_cap", "n_"))
+        def ex(dist_sh, level, degc, mesh, found_cap: int, n_: int):
+            """Merge per-chip discoveries: all-gather each chip's newly-
+            found ids (found_cap = host-sized max) and apply to every
+            replica; returns merged dist (replicated) + stats + the new
+            frontier list."""
+            def per_shard(dist, degc):
+                newly = dist[0][:n_] == level + 1
+                ids = jnp.nonzero(newly, size=found_cap,
+                                  fill_value=n_ + 1)[0].astype(jnp.int32)
+                all_ids = jax.lax.all_gather(ids, VERTEX_AXIS)  # [D, cap]
+                merged = dist[0].at[all_ids.ravel()].min(
+                    level + 1, mode="drop")
+                changed = merged[:n_] == level + 1
+                nf = changed.sum().astype(jnp.int32)
+                frontier = jnp.nonzero(
+                    changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
+                m8_f = jnp.where(changed, degc[:n_], 0) \
+                    .sum(dtype=jnp.int32)
+                unvis = merged[:n_] >= INF
+                m8_unvis = jnp.where(unvis, degc[:n_], 0) \
+                    .sum(dtype=jnp.int32)
+                return merged, frontier, jnp.stack([nf, m8_f, m8_unvis])
+
+            return jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P(VERTEX_AXIS, None), P()),
+                out_specs=(P(), P(), P()), check_vma=False,
+            )(dist_sh, degc)
+        return ex
+    return jit_once("shbfs_exchange", build)
+
+
+def _bu_level():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from titan_tpu.parallel.mesh import VERTEX_AXIS
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("mesh", "c_cap", "p_cap", "n_", "b_max",
+                             "rounds"))
+        def bu(dist, level, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh,
+               mesh, c_cap: int, p_cap: int, n_: int, b_max: int,
+               rounds: int):
+            """One FULLY-LOCAL bottom-up level: each chip scans its own
+            unvisited block vertices against the previous level's
+            (exchange-settled) dist. Chunk rounds with early exit, then
+            an exhaustive sweep for stragglers, all inside one call.
+            Returns per-chip dist + per-chip found counts."""
+            def per_shard(dist, dstT_l, cs_l, degc_l, lo, hi):
+                dstT_l, cs_l, degc_l = dstT_l[0], cs_l[0], degc_l[0]
+                lo, hi = lo[0], hi[0]
+                block = jnp.arange(b_max, dtype=jnp.int32)
+                cand_mask = (block < hi - lo) \
+                    & (dist[jnp.minimum(block + lo, n_)] >= INF) \
+                    & (degc_l > 0)
+                cand = jnp.nonzero(cand_mask, size=c_cap,
+                                   fill_value=b_max)[0].astype(jnp.int32)
+                nc = cand_mask.sum().astype(jnp.int32)
+                off = jnp.zeros((c_cap,), jnp.int32)
+                q_pad = dstT_l.shape[1] - 1
+
+                def round_(state, _):
+                    dist, cand, off, nc = state
+                    alive = jnp.arange(c_cap) < nc
+                    lv = jnp.clip(cand, 0, b_max - 1)
+                    cols = jnp.where(alive, cs_l[lv] + off, q_pad)
+                    parents = jnp.take(dstT_l,
+                                       jnp.clip(cols, 0, q_pad), axis=1)
+                    hit = dist[parents] == level
+                    found = alive & hit.any(axis=0)
+                    gv = jnp.where(found, lv + lo, n_ + 1)
+                    dist = dist.at[gv].set(level + 1, mode="drop")
+                    surv = alive & ~found & (off + 1 < degc_l[lv])
+                    idx = jnp.nonzero(surv, size=c_cap,
+                                      fill_value=c_cap - 1)[0]
+                    nc2 = surv.sum().astype(jnp.int32)
+                    keep = jnp.arange(c_cap) < nc2
+                    cand = jnp.where(keep, cand[idx], b_max)
+                    off = jnp.where(keep, off[idx] + 1, 0)
+                    return (dist, cand, off, nc2), None
+
+                (dist, cand, off, nc), _ = jax.lax.scan(
+                    round_, (dist, cand, off, nc), None, length=rounds)
+
+                # exhaustive sweep for survivors
+                alive = jnp.arange(c_cap) < nc
+                lv = jnp.clip(cand, 0, b_max - 1)
+                rem = jnp.maximum(degc_l[lv] - off, 0)
+                cols, p_total, owner = enumerate_chunk_pairs(
+                    alive, rem, cs_l[lv] + off, p_cap, q_pad,
+                    with_owner=True)
+                parents = jnp.take(dstT_l, cols, axis=1)
+                hit = (dist[parents] == level).any(axis=0)
+                j = jnp.arange(p_cap, dtype=jnp.int32)
+                found_per = jnp.zeros((c_cap,), jnp.int32) \
+                    .at[jnp.where(j < p_total, owner, c_cap - 1)] \
+                    .max(hit.astype(jnp.int32), mode="drop")
+                found = alive & (found_per > 0)
+                gv = jnp.where(found, lv + lo, n_ + 1)
+                dist = dist.at[gv].set(level + 1, mode="drop")
+
+                cnt = (dist[:n_] == level + 1).sum().astype(jnp.int32)
+                counts = jax.lax.all_gather(cnt, VERTEX_AXIS)
+                return dist[None], counts
+
+            return jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P(), P(VERTEX_AXIS, None, None),
+                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
+                          P(VERTEX_AXIS), P(VERTEX_AXIS)),
+                out_specs=(P(VERTEX_AXIS, None), P()), check_vma=False,
+            )(dist, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
+        return bu
+    return jit_once("shbfs_bu", build)
+
+
+def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
+                                max_levels: int = 1000,
+                                return_device: bool = False):
+    """Direction-optimizing BFS over an ICI vertex mesh (see module doc).
+    Returns (dist [n] int32 with INF unreachable, levels)."""
+    import jax
+    import jax.numpy as jnp
+
+    num = int(mesh.devices.size)
+    sh = shard_chunked_csr(snap_or_graph, num)
+    n = sh["n"]
+    b_max = sh["b_max"]
+    cap_n = _next_pow2(max(n, 2))
+    dev = sh.get("_dev")
+    if dev is None:
+        # upload once and cache — re-uploading ~9GB of edge shards per
+        # call would dominate every timed run
+        bounds = sh["bounds"]
+        dev = (jnp.asarray(sh["dstT_sh"]), jnp.asarray(sh["colstart_sh"]),
+               jnp.asarray(sh["degc_sh"]), jnp.asarray(sh["degc"]),
+               jnp.asarray(bounds[:-1].astype(np.int32)),
+               jnp.asarray(bounds[1:].astype(np.int32)))
+        sh["_dev"] = dev
+    dstT_sh, colstart_sh, degc_sh, degc, lo_sh, hi_sh = dev
+    total_chunks = sh["total_chunks"]
+    td = _td_expand()
+    ex = _exchange()
+    bu = _bu_level()
+
+    def pad(a):
+        if a.shape[0] < cap_n:
+            a = jnp.concatenate(
+                [a, jnp.full((cap_n - a.shape[0],), n, a.dtype)])
+        return a
+
+    # dist flow: replicated [n+1] into td/bu (each chip updates its own
+    # copy -> [D, n+1] out), merged back to replicated [n+1] by the
+    # exchange
+    dist = jnp.full((n + 1,), INF, jnp.int32).at[source_dense].set(0)
+    frontier = pad(jnp.full((1,), source_dense, jnp.int32))
+    f_count = 1
+    m8_f = int(np.asarray(degc[source_dense]))
+    m8_unvis = total_chunks - m8_f
+    level = 0
+    while f_count > 0 and level < max_levels:
+        use_bu = m8_f * ALPHA > m8_unvis and f_count > 1
+        if not use_bu:
+            if m8_f == 0:
+                break
+            f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
+            # p_cap covers the heaviest single shard's share; the frontier
+            # chunk total is a safe upper bound for every shard
+            p_cap = min(_next_pow2(max(m8_f, 2)),
+                        _next_pow2(max(total_chunks + n, 2)))
+            dist, counts = td(dist, frontier[:f_cap], jnp.int32(f_count),
+                              jnp.int32(level), dstT_sh, colstart_sh,
+                              degc_sh, lo_sh, hi_sh, mesh=mesh,
+                              f_cap=f_cap, p_cap=p_cap, n_=n, b_max=b_max)
+        else:
+            c_cap = _next_pow2(max(b_max, 2))
+            p_cap = _next_pow2(max(sh["q_max"], 2))
+            dist, counts = bu(dist, jnp.int32(level), dstT_sh,
+                              colstart_sh, degc_sh, lo_sh, hi_sh,
+                              mesh=mesh, c_cap=c_cap, p_cap=p_cap, n_=n,
+                              b_max=b_max, rounds=BU_CHUNK_ROUNDS)
+        found_max = int(np.asarray(counts).max())
+        found_cap = _next_pow2(max(found_max, 2))
+        dist, frontier, st = ex(dist, jnp.int32(level), degc, mesh=mesh,
+                                found_cap=found_cap, n_=n)
+        frontier = pad(frontier)
+        f_count, m8_f, m8_unvis = (int(x) for x in np.asarray(st))
+        level += 1
+    out = dist[0, :n] if dist.ndim == 2 else dist[:n]
+    if not return_device:
+        out = np.asarray(out)
+    return out, level

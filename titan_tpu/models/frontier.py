@@ -60,6 +60,118 @@ def slot_weights_np(slots: np.ndarray, min_w: float = 0.0,
     return (min_w + w_range * u).astype(np.float32)
 
 
+# above this frontier chunk mass, rounds run as dense window sweeps
+# (the enumeration path would materialize an [8, p_cap] block - 8.6GB at
+# p_cap=2^28 - on top of a 9GB scale-26 graph)
+DENSE_THRESHOLD_CHUNKS = 1 << 25
+DENSE_WINDOW = 1 << 24
+
+
+def _colowner(g):
+    """column -> owning vertex map (lazy, cached in the graph dict):
+    lets dense sweeps read contiguous column windows with no pair
+    enumeration. Pad/sink columns own the sink vertex n."""
+    import jax.numpy as jnp
+
+    co = g.get("colowner")
+    if co is None:
+        n = g["n"]
+        q_total = g["q_total"]
+        # computed on device (jnp.repeat with a static total length) —
+        # reading colstart back to build it on the host would D2H 268MB
+        # at scale 26
+        degc = g["degc"]
+        ids = jnp.arange(n + 1, dtype=jnp.int32)
+        owner = jnp.repeat(ids, degc, total_repeat_length=q_total - 1)
+        co = jnp.concatenate([owner, jnp.full((1,), n, jnp.int32)])
+        g["colowner"] = co
+    return co
+
+
+def _dense_step(kind: str):
+    """One WINDOW of a dense sweep: relax every column in
+    [w0, w0+W) whose owner improved last round. No readback."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("W", "n_"),
+                           donate_argnums=(0,))
+        def step(val, changed, w0, dstT, colowner, wparams, W: int,
+                 n_: int):
+            w0 = jnp.minimum(w0, colowner.shape[0] - W)
+            owner = jax.lax.dynamic_slice(colowner, (w0,), (W,))
+            nbr = jax.lax.dynamic_slice(dstT, (0, w0), (8, W))
+            active = changed[owner]
+            src_val = val[owner]
+            if kind == "sssp":
+                lane = jnp.arange(8, dtype=jnp.int32)[:, None]
+                slot = (jnp.arange(W, dtype=jnp.int32) + w0)[None, :] * 8 \
+                    + lane
+                w = _hash_weight_expr(slot, wparams[0], wparams[1])
+                msg = src_val[None, :] + w
+            else:
+                msg = jnp.broadcast_to(src_val[None, :], nbr.shape)
+            big = jnp.asarray(FINF, val.dtype) if kind == "sssp" \
+                else jnp.asarray(IINF, val.dtype)
+            msg = jnp.where(active[None, :], msg, big)
+            return val.at[nbr].min(msg, mode="drop")
+        return step
+    return jit_once(f"frontier_dense_{kind}", build)
+
+
+def _dense_wrap(kind: str):
+    """After a dense round's windows: the new changed mask + stats
+    (frontier lists are built lazily when dropping back to the
+    enumeration path)."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_",))
+        def wrap(val, val_old, degc, n_: int):
+            changed = val[:n_] < val_old[:n_]
+            nf = changed.sum().astype(jnp.int32)
+            m8 = jnp.where(changed, degc[:n_], 0).sum(dtype=jnp.int32)
+            cmask = jnp.concatenate(
+                [changed, jnp.zeros((1,), bool)])
+            return cmask, jnp.stack([nf, m8])
+        return wrap
+    return jit_once(f"frontier_dense_wrap_{kind}", build)
+
+
+def _frontier_list():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_", "cap"))
+        def fl(cmask, n_: int, cap: int):
+            ids = jnp.nonzero(cmask[:n_], size=n_, fill_value=n_)[0] \
+                .astype(jnp.int32)
+            if cap > n_:
+                ids = jnp.concatenate(
+                    [ids, jnp.full((cap - n_,), n_, jnp.int32)])
+            return ids
+        return fl
+    return jit_once("frontier_list", build)
+
+
+def _mask_from_list():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_",))
+        def mk(frontier, f_count, n_: int):
+            valid = jnp.arange(frontier.shape[0]) < f_count
+            tgt = jnp.where(valid, jnp.minimum(frontier, n_), n_ + 1)
+            return jnp.zeros((n_ + 1,), bool).at[tgt].set(
+                True, mode="drop")
+        return mk
+    return jit_once("frontier_mask_from_list", build)
+
+
 def _push_step(kind: str):
     """One frontier-push round: expand the frontier's chunks, relax
     min(value) into neighbors, return the new frontier (= improved
@@ -119,18 +231,41 @@ def _frontier_run(snap_or_graph, val0, kind: str, wparams,
     total_chunks = g["q_total"] - 1
     cap_n = _next_pow2(max(n, 2))
     push = _push_step(kind)
-    val, frontier, f_count, m8_f = val0
+    dense = _dense_step(kind)
+    dwrap = _dense_wrap(kind)
+    flist = _frontier_list()
+    val, frontier, f_count, m8_f, cmask = val0
 
     wp = jnp.asarray(np.asarray(wparams, np.float32))
+    W = min(DENSE_WINDOW, _next_pow2(max(total_chunks, 2)))
     rounds = 0
     while f_count > 0 and m8_f > 0 and rounds < max_rounds:
-        f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
-        p_cap = min(_next_pow2(max(m8_f, 2)),
-                    _next_pow2(max(total_chunks + n, 2)))
-        val, frontier, st = push(val, frontier[:f_cap],
-                                 jnp.int32(f_count), dstT, colstart, degc,
-                                 wp, f_cap=f_cap, p_cap=p_cap, n_=n)
-        f_count, m8_f = (int(x) for x in np.asarray(st))
+        if m8_f > DENSE_THRESHOLD_CHUNKS and total_chunks + 1 >= W:
+            # dense window sweep: contiguous column slices, activity
+            # masked by last round's changed set, no pair enumeration
+            colowner = _colowner(g)
+            if cmask is None:    # entering dense mode from a list round
+                cmask = _mask_from_list()(frontier, jnp.int32(f_count),
+                                          n_=n)
+            val_old = val + 0 if kind == "wcc" else val + 0.0
+            for w0 in range(0, total_chunks + 1, W):
+                val = dense(val, cmask, jnp.int32(w0), dstT, colowner,
+                            wp, W=W, n_=n)
+            cmask, st = dwrap(val, val_old, degc, n_=n)
+            f_count, m8_f = (int(x) for x in np.asarray(st))
+            frontier = None
+        else:
+            if frontier is None:     # dropping out of dense mode
+                frontier = flist(cmask, n_=n, cap=cap_n)
+            f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
+            p_cap = min(_next_pow2(max(m8_f, 2)),
+                        _next_pow2(max(total_chunks + n, 2)))
+            val, frontier, st = push(val, frontier[:f_cap],
+                                     jnp.int32(f_count), dstT, colstart,
+                                     degc, wp, f_cap=f_cap, p_cap=p_cap,
+                                     n_=n)
+            f_count, m8_f = (int(x) for x in np.asarray(st))
+            cmask = None
         rounds += 1
     return val[:n], rounds
 
@@ -149,11 +284,88 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
     val = jnp.full((n + 1,), FINF, jnp.float32).at[source_dense].set(0.0)
     frontier = jnp.full((cap_n,), n, jnp.int32).at[0].set(source_dense)
     m8 = int(np.asarray(g["degc"][source_dense]))
-    out, rounds = _frontier_run(g, (val, frontier, 1, m8), "sssp",
+    out, rounds = _frontier_run(g, (val, frontier, 1, m8, None), "sssp",
                                 (min_w, w_range), max_rounds)
     if not return_device:
         out = np.asarray(out)
     return out, rounds
+
+
+def _pr_window():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("W",),
+                           donate_argnums=(0,))
+        def step(acc, contrib, w0, dstT, colowner, W: int):
+            # the final window's slice start gets clamped so it fits, which
+            # OVERLAPS the previous window; scatter-ADD is not idempotent,
+            # so already-processed columns must contribute exactly 0
+            w0c = jnp.minimum(w0, colowner.shape[0] - W)
+            owner = jax.lax.dynamic_slice(colowner, (w0c,), (W,))
+            nbr = jax.lax.dynamic_slice(dstT, (0, w0c), (8, W))
+            fresh = (w0c + jnp.arange(W, dtype=jnp.int32)) >= w0
+            c = jnp.where(fresh, contrib[owner], 0.0)
+            return acc.at[nbr].add(jnp.broadcast_to(c[None, :], nbr.shape),
+                                   mode="drop")
+        return step
+    return jit_once("pagerank_window", build)
+
+
+def _pr_finish():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_",))
+        def fin(acc, rank, deg, damping, n_: int):
+            new_rank = (1.0 - damping) / n_ + damping * acc[:n_]
+            new_rank = jnp.concatenate(
+                [new_rank, jnp.zeros((1,), jnp.float32)])
+            delta = jnp.abs(new_rank[:n_] - rank[:n_]).sum()
+            contrib = jnp.where(deg > 0, new_rank / jnp.maximum(deg, 1), 0.0)
+            return new_rank, contrib, delta
+        return fin
+    return jit_once("pagerank_finish", build)
+
+
+def pagerank_dense(snap_or_graph, iterations: int = 20,
+                   damping: float = 0.85, tol: float | None = None,
+                   return_device: bool = False):
+    """Push-mode PageRank over the chunked CSR via dense window sweeps:
+    rank' = (1-d)/n + d * sum over in-edges of rank[src]/outdeg[src]
+    (semantics match the pull-mode engine program in models/pagerank.py,
+    incl. leaking dangling mass). Returns (rank float32 [n], iterations
+    run). ``tol``: early exit when the L1 delta falls below it."""
+    import jax.numpy as jnp
+
+    g = snap_or_graph if isinstance(snap_or_graph, dict) \
+        else build_chunked_csr(snap_or_graph)
+    n = g["n"]
+    dstT = g["dstT"]
+    deg = g["deg"].astype(jnp.float32)
+    colowner = _colowner(g)
+    total = g["q_total"]
+    W = min(DENSE_WINDOW, total)
+    win = _pr_window()
+    fin = _pr_finish()
+    rank = jnp.full((n + 1,), 1.0 / n, jnp.float32) \
+        .at[n].set(0.0)
+    contrib = jnp.where(deg > 0, rank / jnp.maximum(deg, 1.0), 0.0)
+    it = 0
+    for it in range(1, iterations + 1):
+        acc = jnp.zeros((n + 1,), jnp.float32)
+        for w0 in range(0, total, W):
+            acc = win(acc, contrib, jnp.int32(w0), dstT, colowner, W=W)
+        rank, contrib, delta = fin(acc, rank, deg,
+                                   jnp.float32(damping), n_=n)
+        if tol is not None and float(delta) < tol:
+            break
+    out = rank[:n]
+    if not return_device:
+        out = np.asarray(out)
+    return out, it
 
 
 def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
@@ -173,9 +385,11 @@ def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
         [jnp.arange(n, dtype=jnp.int32),
          jnp.full((cap_n - n,), n, jnp.int32)]) if cap_n > n \
         else jnp.arange(cap_n, dtype=jnp.int32)
+    cmask = jnp.concatenate([jnp.ones((n,), bool),
+                             jnp.zeros((1,), bool)])
     total_chunks = int(g["q_total"]) - 1
-    out, rounds = _frontier_run(g, (val, frontier, n, total_chunks), "wcc",
-                                (0.0, 0.0), max_rounds)
+    out, rounds = _frontier_run(g, (val, frontier, n, total_chunks, cmask),
+                                "wcc", (0.0, 0.0), max_rounds)
     if not return_device:
         out = np.asarray(out)
     return out, rounds

@@ -48,15 +48,24 @@ def load_or_build(scale: int, edge_factor: int = 16, seed: int = 2,
         out.update(meta)
         return out
 
-    if not native.available:
-        raise RuntimeError("graph500 pipeline needs the native module")
     n = 1 << scale
     m = n * edge_factor
     t0 = time.time()
-    src, dst = native.rmat_gen(m, scale, seed=seed)
-    t1 = time.time()
-    flat, colstart64, deg, deg_orig = native.sym_chunked_csr(src, dst, n)
-    del src, dst
+    if native.available:
+        src, dst = native.rmat_gen(m, scale, seed=seed)
+        t1 = time.time()
+        flat, colstart64, deg, deg_orig = native.sym_chunked_csr(src, dst,
+                                                                 n)
+        del src, dst
+    else:
+        # pure-numpy fallback (no C++ toolchain): fine for CI scales,
+        # far too slow for scale 26
+        from titan_tpu.olap.tpu.rmat import rmat_edges
+        src, dst = rmat_edges(scale, edge_factor, seed=seed)
+        t1 = time.time()
+        flat, colstart64, deg, deg_orig = _sym_chunked_csr_numpy(src, dst,
+                                                                 n)
+        del src, dst
     t2 = time.time()
     q_total = flat.shape[0]
     # the kernels index COLUMNS (q_total) and vertices only — never flat
@@ -87,6 +96,31 @@ def load_or_build(scale: int, edge_factor: int = 16, seed: int = 2,
            "deg_orig": deg_orig}
     out.update(meta)
     return out
+
+
+def _sym_chunked_csr_numpy(src, dst, n: int):
+    """Numpy mirror of native.sym_chunked_csr (symmetrize, per-vertex
+    sort-dedup incl. self-loop drop, 8-aligned chunk layout)."""
+    v = np.concatenate([src, dst]).astype(np.int64)
+    w = np.concatenate([dst, src]).astype(np.int64)
+    deg_orig = np.bincount(v, minlength=n).astype(np.int32)
+    packed = np.unique(v * (n + 1) + w)
+    pv = (packed // (n + 1)).astype(np.int64)
+    pw = (packed % (n + 1)).astype(np.int64)
+    keep = pv != pw
+    pv, pw = pv[keep], pw[keep]
+    deg = np.bincount(pv, minlength=n).astype(np.int32)
+    degc = -(-deg.astype(np.int64) // 8)
+    colstart64 = np.zeros(n + 1, np.int64)
+    np.cumsum(degc, out=colstart64[1:])
+    q_total = int(colstart64[-1]) + 1
+    flat = np.full(q_total * 8, n + 1, np.int32)
+    starts8 = colstart64[:n] * 8
+    pos = np.repeat(starts8 - np.concatenate(
+        [[0], np.cumsum(deg.astype(np.int64))])[:n], deg) \
+        + np.arange(len(pw), dtype=np.int64)
+    flat[pos] = pw
+    return flat.reshape(q_total, 8), colstart64, deg, deg_orig
 
 
 def to_device(host_graph: dict) -> dict:
