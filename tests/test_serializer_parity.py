@@ -1,0 +1,189 @@
+"""Serializer-registry parity checklist against the reference.
+
+Names every file in the reference's registry (titan-core
+graphdb/database/serialize/attribute/, 29 serializers registered by
+StandardSerializer.java) and its analog here, and exercises each covered
+analog: self-describing round-trip, and — where the reference provides a
+byte-order-preserving codec — that our ordered encoding sorts identically
+to the values (reference: titan-test graphdb/serializer/SerializerTest
+round-trip + order semantics).
+"""
+
+import datetime
+import enum
+import uuid
+
+import numpy as np
+import pytest
+
+from titan_tpu.codec.attributes import DEFAULT, Serializer
+
+
+class Color(enum.Enum):
+    RED = 1
+    GREEN = 2
+    BLUE = 3
+
+
+# reference serializer -> (our carrier value(s), orderable?) or a
+# justification string for n/a rows
+PARITY = {
+    "BooleanSerializer": ([True, False], True),
+    "ByteSerializer": ([-128, 0, 127], True),           # int codec
+    "ShortSerializer": ([-32768, 0, 32767], True),      # int codec
+    "IntegerSerializer": ([-2**31, 0, 2**31 - 1], True),
+    "LongSerializer": ([-2**62, -1, 0, 1, 2**62], True),
+    "CharacterSerializer": (["a", "é"], True),     # 1-char str
+    "FloatSerializer": ([-1.5, 0.0, 2.25], True),
+    "DoubleSerializer": ([-1e300, -0.0, 1e-300, 3.14], True),
+    "StringSerializer": (["", "abc", "zürich"], True),
+    "DateSerializer": ([
+        datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc),
+        datetime.datetime(2026, 7, 30, 12, 34, 56,
+                          tzinfo=datetime.timezone.utc)], True),
+    "InstantSerializer": ([
+        datetime.datetime(2001, 2, 3, 4, 5, 6,
+                          tzinfo=datetime.timezone.utc)], True),
+    "DurationSerializer": ([datetime.timedelta(days=-2),
+                            datetime.timedelta(microseconds=1)], True),
+    "EnumSerializer": ([Color.RED, Color.BLUE], False),
+    "UUIDSerializer": ([uuid.UUID(int=0), uuid.uuid5(
+        uuid.NAMESPACE_DNS, "titan")], True),
+    "ByteArraySerializer": ([b"", b"\x00\xff"], True),  # bytes codec
+    "BooleanArraySerializer": ([np.array([True, False])], False),
+    "CharArraySerializer": (["chars-as-str"], True),
+    "ShortArraySerializer": ([np.array([-3, 7], np.int16)], False),
+    "IntArraySerializer": ([np.array([1, 2, 3], np.int32)], False),
+    "LongArraySerializer": ([np.array([2**40], np.int64)], False),
+    "FloatArraySerializer": ([np.array([1.5], np.float32)], False),
+    "DoubleArraySerializer": ([np.array([2.5], np.float64)], False),
+    "StringArraySerializer": ([["a", "b"]], False),     # list codec
+    "ArraySerializer": ([[1, "mixed", 2.5]], False),    # list codec
+    "ObjectSerializer":
+        "deliberate divergence: arbitrary-object pickling is a "
+        "deserialization RCE vector; custom types register explicit "
+        "handlers via Serializer.register (the reference's "
+        "attributes.custom.* mechanism)",
+    "ParameterSerializer":
+        "index parameters are plain (str, value) pairs here, stored "
+        "through the dict/list codecs by the schema layer "
+        "(core/schema.py TypeDefinition) rather than a dedicated type",
+    "ParameterArraySerializer":
+        "see ParameterSerializer (list codec)",
+    "StandardTransactionIdSerializer":
+        "WAL records carry (instance_id, tx_ts) through the log codec "
+        "(core/wal.py), not the attribute registry",
+    "TypeDefinitionDescriptionSerializer":
+        "schema definitions are vertices whose properties use the "
+        "ordinary value codecs (core/schema.py schema-as-vertices)",
+}
+
+
+def test_checklist_is_exhaustive_against_reference_listing():
+    # the 29 serializer files in the reference package
+    assert len(PARITY) == 29
+
+
+@pytest.mark.parametrize("name", sorted(PARITY))
+def test_round_trip_or_justification(name):
+    row = PARITY[name]
+    if isinstance(row, str):
+        assert len(row) > 20       # a real justification, not a stub
+        return
+    values, _ = row
+    for v in values:
+        b = DEFAULT.value_bytes(v)
+        got = DEFAULT.value_from_bytes(b)
+        if isinstance(v, np.ndarray):
+            assert np.array_equal(got, v) and got.dtype == v.dtype
+        else:
+            assert got == v and type(got) is type(v)
+
+
+@pytest.mark.parametrize("name", sorted(
+    n for n, row in PARITY.items()
+    if not isinstance(row, str) and row[1]))
+def test_order_preserving_variants(name):
+    values, _ = PARITY[name]
+    t = type(values[0])
+    assert DEFAULT.orderable(t), f"{name}: {t} must be orderable"
+    enc = [DEFAULT.ordered_bytes(v, t) for v in values]
+    order_vals = sorted(range(len(values)), key=lambda i: values[i])
+    order_enc = sorted(range(len(values)), key=lambda i: enc[i])
+    assert order_vals == order_enc
+    # and the ordered form round-trips
+    from titan_tpu.codec.attributes import ReadBuffer
+    for v, e in zip(values, enc):
+        assert DEFAULT.read_ordered(ReadBuffer(e), t) == v
+
+
+def test_ordered_int_random_sort_parity():
+    rng = np.random.default_rng(0)
+    vals = [int(x) for x in rng.integers(-2**62, 2**62, 200)]
+    enc = [DEFAULT.ordered_bytes(v, int) for v in vals]
+    assert sorted(range(200), key=lambda i: vals[i]) == \
+        sorted(range(200), key=lambda i: enc[i])
+
+
+def test_ordered_float_random_sort_parity():
+    rng = np.random.default_rng(1)
+    vals = [float(x) for x in rng.normal(0, 1e10, 200)] + \
+        [0.0, -0.0, 1e-320, -1e-320]
+    enc = [DEFAULT.ordered_bytes(v, float) for v in vals]
+    key_v = sorted(range(len(vals)), key=lambda i: (vals[i], enc[i]))
+    key_e = sorted(range(len(vals)), key=lambda i: (enc[i],))
+    # -0.0 == 0.0 compare equal; tie-break by encoding for determinism
+    assert [vals[i] for i in key_v] == [vals[i] for i in key_e]
+
+
+def test_enum_rejects_unknown_and_custom_registration():
+    # a fresh registry without Enum still allows explicit registration
+    s = Serializer()
+
+    class Weird:
+        def __init__(self, x):
+            self.x = x
+
+        def __eq__(self, other):
+            return isinstance(other, Weird) and other.x == self.x
+
+    from titan_tpu.codec.attributes import AttributeHandler
+    s.register(AttributeHandler(
+        200, Weird,
+        lambda o, v: o.put_uvar(v.x),
+        lambda b: Weird(b.get_uvar())))
+    assert s.value_from_bytes(s.value_bytes(Weird(7))) == Weird(7)
+
+
+def test_time_ordered_variant():
+    vals = [datetime.time(0, 0), datetime.time(12, 30, 15, 250),
+            datetime.time(23, 59, 59, 999999)]
+    enc = [DEFAULT.ordered_bytes(v, datetime.time) for v in vals]
+    assert enc == sorted(enc)
+    with pytest.raises(TypeError):
+        DEFAULT.ordered_bytes(
+            datetime.time(1, 2, tzinfo=datetime.timezone.utc),
+            datetime.time)
+
+
+def test_int_enum_and_str_enum_keep_their_type():
+    b = DEFAULT.value_bytes(Priority.HIGH)
+    assert DEFAULT.value_from_bytes(b) is Priority.HIGH
+    b2 = DEFAULT.value_bytes(Tag.X)
+    assert DEFAULT.value_from_bytes(b2) is Tag.X
+
+
+class Priority(enum.IntEnum):
+    LOW = 1
+    HIGH = 2
+
+
+class Tag(str, enum.Enum):
+    X = "x"
+
+
+def test_local_enum_rejected_at_write_time():
+    class Local(enum.Enum):
+        A = 1
+    with pytest.raises(TypeError, match="importable"):
+        DEFAULT.value_bytes(Local.A)

@@ -71,19 +71,22 @@ def test_shard_layout_int32_safety_at_scale26_shape():
 
 def test_sharded_hybrid_uses_sparse_exchange_not_full_pmin():
     """The exchange gathers found-id lists sized by the actual per-chip
-    discovery maxima — found_cap stays tiny on a tiny frontier (the
-    round-1 design all-reduced all n elements every level)."""
-    src, dst = rmat_edges(9, 4, seed=3)
-    n = 1 << 9
+    discovery maxima (the round-1 design all-reduced all n elements
+    every level). On a path graph the frontier is ONE vertex per level,
+    so every exchange cap must stay tiny regardless of n."""
+    n = 400
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = src + 1
     snap = sym_snap_from(src, dst, n)
-    source = int(np.flatnonzero(snap.out_degree > 0)[0])
     mesh = vertex_mesh(8)
+    d_sh, levels = S.frontier_bfs_hybrid_sharded(snap, 0, mesh)
+    d_ref, _ = frontier_bfs(snap, 0)
+    assert (np.asarray(d_sh) == d_ref).all()
+    assert levels in (n - 1, n)   # final empty round may count
+    assert S.LAST_EXCHANGE_CAPS, "exchange instrumentation missing"
+    assert max(S.LAST_EXCHANGE_CAPS) <= 8 < n
+    # and the per-shard edge arrays are genuinely partitioned
     from titan_tpu.models.bfs_hybrid import build_chunked_csr
     sh = S.shard_chunked_csr(build_chunked_csr(snap), 8)
     assert sh["dstT_sh"].shape[0] == 8
-    # per-shard edge arrays are genuinely partitioned: each shard's local
-    # columns cover only its vertex range
     assert sh["q_max"] <= sh["q_total"]
-    d_sh, _ = S.frontier_bfs_hybrid_sharded(snap, source, mesh)
-    d_ref, _ = frontier_bfs(snap, source)
-    assert (np.asarray(d_sh) == d_ref).all()

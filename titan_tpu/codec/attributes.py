@@ -38,12 +38,16 @@ class AttributeHandler:
         self.py_type = py_type
         self.write = write
         self.read = read
+        # an explicitly-passed ordered codec marks the type orderable even
+        # when it IS the plain codec (bool/uuid: the natural bytes already
+        # sort correctly)
+        self._orderable = write_ordered is not None
         self.write_ordered = write_ordered or write
         self.read_ordered = read_ordered or read
 
     @property
     def orderable(self) -> bool:
-        return self.write_ordered is not self.write or self.read_ordered is not self.read
+        return self._orderable
 
 
 # -- primitives ---------------------------------------------------------------
@@ -206,7 +210,8 @@ class Serializer:
         self._by_code: dict[int, AttributeHandler] = {}
         self._by_type: dict[type, AttributeHandler] = {}
         # codes are part of the stored format — never renumber
-        self.register(AttributeHandler(1, bool, _w_bool, _r_bool))
+        self.register(AttributeHandler(1, bool, _w_bool, _r_bool,
+                                       _w_bool, _r_bool))
         self.register(AttributeHandler(2, int, _w_long, _r_long,
                                        _w_long_ordered, _r_long_ordered))
         self.register(AttributeHandler(3, float, _w_f64, _r_f64,
@@ -216,7 +221,9 @@ class Serializer:
         self.register(AttributeHandler(5, bytes, _w_bytes, _r_bytes,
                                        _w_bytes_ordered,
                                        lambda b: _unescape(b)))
-        self.register(AttributeHandler(6, _uuid.UUID, _w_uuid, _r_uuid))
+        # the 16 fixed big-endian bytes ARE the RFC-4122 sort order
+        self.register(AttributeHandler(6, _uuid.UUID, _w_uuid, _r_uuid,
+                                       _w_uuid, _r_uuid))
         self.register(AttributeHandler(7, _dt.datetime, _w_date, _r_date,
                                        _w_date_ordered, _r_date_ordered))
         self.register(AttributeHandler(8, list, self._w_list, self._r_list))
@@ -248,10 +255,26 @@ class Serializer:
             lambda b: _dt.date.fromordinal(b.get_svar()),
             lambda o, v: _w_long_ordered(o, _ordinal(v)),
             lambda b: _dt.date.fromordinal(_r_long_ordered(b))))
+        def _time_micros(v) -> int:
+            if v.tzinfo is not None:
+                raise TypeError(
+                    "tz-aware time has no total order (offsets vary); "
+                    "store naive times or a full datetime")
+            return ((v.hour * 60 + v.minute) * 60 + v.second) * 1_000_000 \
+                + v.microsecond
+
+        def _time_from_micros(us: int) -> _dt.time:
+            s, us = divmod(us, 1_000_000)
+            m, s = divmod(s, 60)
+            h, m = divmod(m, 60)
+            return _dt.time(h, m, s, us)
+
         self.register(AttributeHandler(
             14, _dt.time,
             lambda o, v: _w_str(o, v.isoformat()),
-            lambda b: _dt.time.fromisoformat(_r_str(b))))
+            lambda b: _dt.time.fromisoformat(_r_str(b)),
+            lambda o, v: _w_long_ordered(o, _time_micros(v)),
+            lambda b: _time_from_micros(_r_long_ordered(b))))
 
         def _micros(v) -> int:
             us = v.days * 86_400_000_000 + v.seconds * 1_000_000 \
@@ -306,6 +329,34 @@ class Serializer:
 
         self.register(AttributeHandler(19, _np.ndarray, _w_ndarray,
                                        _r_ndarray))
+        # Enum members (reference: serialize/attribute/EnumSerializer —
+        # stores the enum class + ordinal; here class path + member name,
+        # resilient to member reordering)
+        import enum as _enum
+        import importlib as _importlib
+
+        def _w_enum(o, v):
+            cls = type(v)
+            # refuse classes that cannot be re-imported by path (local
+            # scopes, __main__): the bytes would be permanently unreadable
+            if "<locals>" in cls.__qualname__ or \
+                    cls.__module__ in ("__main__", "builtins"):
+                raise TypeError(
+                    f"enum class {cls.__qualname__} is not importable by "
+                    f"path (module {cls.__module__!r}); move it to a "
+                    f"module before storing its members")
+            _w_str(o, f"{cls.__module__}:{cls.__qualname__}")
+            _w_str(o, v.name)
+
+        def _r_enum(b):
+            path, name = _r_str(b), _r_str(b)
+            mod_name, _, qual = path.partition(":")
+            obj = _importlib.import_module(mod_name)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+            return obj[name]
+
+        self.register(AttributeHandler(20, _enum.Enum, _w_enum, _r_enum))
 
     def register(self, h: AttributeHandler):
         if h.code in self._by_code or h.py_type in self._by_type:
@@ -314,9 +365,14 @@ class Serializer:
         self._by_type[h.py_type] = h
 
     def handler_for(self, value_or_type) -> AttributeHandler:
+        import enum as _enum
         t = value_or_type if isinstance(value_or_type, type) else type(value_or_type)
         h = self._by_type.get(t)
         if h is None:
+            # Enum FIRST: IntEnum/StrEnum also subclass int/str, and the
+            # primitive handlers would silently strip the enum type
+            if issubclass(t, _enum.Enum) and _enum.Enum in self._by_type:
+                return self._by_type[_enum.Enum]
             for base, hh in self._by_type.items():
                 if base is not type(None) and issubclass(t, base):
                     return hh

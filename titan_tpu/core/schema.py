@@ -63,6 +63,12 @@ import numpy as _np
 
 register_dtype("ndarray", _np.ndarray)
 
+import enum as _enum
+
+# any Enum subclass maps to the base dtype (the serializer stores the
+# concrete class path per value; reference: EnumSerializer)
+register_dtype("enum", _enum.Enum)
+
 
 @dataclass(frozen=True)
 class SchemaType:

@@ -45,6 +45,10 @@ ALPHA = 8.0
 BU_CHUNK_ROUNDS = 8
 BU_FUSE = 4
 
+# instrumentation: found_cap used by each level's exchange in the most
+# recent run (tests assert the exchange stays sparse)
+LAST_EXCHANGE_CAPS: list = []
+
 
 def shard_chunked_csr(snap_or_graph, num_shards: int):
     """Edge-balanced vertex-range shards of the chunked CSR, padded to
@@ -338,6 +342,7 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
     m8_f = int(np.asarray(degc[source_dense]))
     m8_unvis = total_chunks - m8_f
     level = 0
+    LAST_EXCHANGE_CAPS.clear()
     while f_count > 0 and level < max_levels:
         use_bu = m8_f * ALPHA > m8_unvis and f_count > 1
         if not use_bu:
@@ -361,6 +366,7 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
                               b_max=b_max, rounds=BU_CHUNK_ROUNDS)
         found_max = int(np.asarray(counts).max())
         found_cap = _next_pow2(max(found_max, 2))
+        LAST_EXCHANGE_CAPS.append(found_cap)
         dist, frontier, st = ex(dist, jnp.int32(level), degc, mesh=mesh,
                                 found_cap=found_cap, n_=n)
         frontier = pad(frontier)
