@@ -187,3 +187,30 @@ def test_local_enum_rejected_at_write_time():
         A = 1
     with pytest.raises(TypeError, match="importable"):
         DEFAULT.value_bytes(Local.A)
+
+
+def test_enum_read_guard_rejects_non_enum_paths():
+    from titan_tpu.codec.dataio import DataOutput
+    out = DataOutput()
+    out.put_u8(20)                     # enum type code
+    for s in ("os:path", "getcwd"):    # module attr that is NOT an Enum
+        b = s.encode()
+        out.put_uvar(len(b))
+        out.put_bytes(b) if hasattr(out, "put_bytes") else [
+            out.put_u8(x) for x in b]
+    with pytest.raises(TypeError, match="Enum class"):
+        DEFAULT.value_from_bytes(out.getvalue())
+
+
+def test_int_enum_schema_key_gets_enum_dtype():
+    import titan_tpu
+    g = titan_tpu.open("inmemory")
+    tx = g.new_transaction()
+    tx.add_vertex("job", prio=Priority.HIGH)
+    tx.commit()
+    key = g.schema.get_by_name("prio")
+    assert key.dtype is enum.Enum
+    tx = g.new_transaction()
+    [v] = [x for x in tx.vertices()]
+    assert v.value("prio") is Priority.HIGH
+    g.close()

@@ -354,6 +354,13 @@ class Serializer:
             obj = _importlib.import_module(mod_name)
             for part in qual.split("."):
                 obj = getattr(obj, part)
+            # guard the deserialization surface: only genuine Enum
+            # classes may be indexed (arbitrary __getitem__ on a stored
+            # path would be an attack vector)
+            if not (isinstance(obj, type) and issubclass(obj, _enum.Enum)):
+                raise TypeError(
+                    f"stored enum path {path!r} does not resolve to an "
+                    f"Enum class")
             return obj[name]
 
         self.register(AttributeHandler(20, _enum.Enum, _w_enum, _r_enum))

@@ -113,12 +113,23 @@ class EdgeCodec:
             val.put_uvar_backward(relation_id)
         return Entry(col.getvalue(), val.getvalue())
 
+    # STORED-FORMAT FREEZE: the SET-value codec choice is part of the row
+    # format. v1 shipped with exactly these dtypes on the order-preserving
+    # codec; the serializer's orderable set has since widened (bool, UUID,
+    # time), but flipping the codec for a dtype would silently misread
+    # rows written before the widening — so the choice is pinned here and
+    # may only change with a row-format version bump.
+    def _set_value_ordered(self, dtype: type) -> bool:
+        import datetime as _dt
+        return dtype in (int, float, str, bytes, _dt.datetime, _dt.date,
+                         _dt.timedelta)
+
     def _write_set_value(self, out: DataOutput, value: Any, dtype: type):
         # deterministic by declared dtype (write and read must agree):
-        # orderable dtypes use the order-preserving codec, others the
-        # self-describing one; uniqueness holds either way (same value →
-        # same bytes)
-        if self.serializer.orderable(dtype):
+        # frozen-orderable dtypes use the order-preserving codec, others
+        # the self-describing one; uniqueness holds either way (same
+        # value → same bytes)
+        if self._set_value_ordered(dtype):
             self.serializer.write_ordered(out, value, dtype)
         else:
             self.serializer.write_value(out, value)
@@ -191,7 +202,7 @@ class EdgeCodec:
         elif card is Cardinality.SET:
             relation_id = val.get_uvar_backward_from_end()
             dtype = inspector.data_type(key_id)
-            if self.serializer.orderable(dtype):
+            if self._set_value_ordered(dtype):
                 value = self.serializer.read_ordered(col, dtype)
             else:
                 value = self.serializer.read_value(col)

@@ -313,10 +313,16 @@ class SchemaManager:
                                        f"(auto schema disabled)")
         dtype = type(sample_value) if sample_value is not None else str
         if dtype not in _DTYPE_NAMES:
-            for base in _DTYPE_NAMES:
-                if isinstance(sample_value, base):
-                    dtype = base
-                    break
+            # Enum FIRST (mirrors the serializer's handler_for): IntEnum/
+            # StrEnum also pass isinstance(int/str) and the generic loop
+            # would auto-create a primitive-typed key
+            if isinstance(sample_value, _enum.Enum):
+                dtype = _enum.Enum
+            else:
+                for base in _DTYPE_NAMES:
+                    if isinstance(sample_value, base):
+                        dtype = base
+                        break
         return self._create_or_adopt(name, PropertyKey,
                                      lambda: self.make_property_key(name, dtype))
 

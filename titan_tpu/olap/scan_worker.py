@@ -1,0 +1,176 @@
+"""Multi-host distributed scan: HTTP scan workers + a failover coordinator.
+
+Completes the distributed-OLAP tier past one host (reference:
+titan-hadoop-core scan/HadoopScanMapper.java:33-110 runs any ScanJob in
+YARN containers across a cluster; MapReduceIndexManagement.java:50 drives
+REINDEX/REMOVE that way). Here the container role is a long-lived
+**scan worker node** (``python -m titan_tpu.olap.scan_worker``) on each
+host: the coordinator splits the key space on partition boundaries
+(olap/distributed.key_splits), ships each split as a ScanJobSpec over
+HTTP, and merges the returned ScanMetrics — with re-dispatch of a dead
+worker's splits to the survivors, the Hadoop re-run-failed-mapper
+semantics (split scans are idempotent).
+
+Workers open their own graph connection per request from the shipped
+config, exactly like HadoopScanMapper.setup reconstructs the job from
+serialized config; pointing that config at a ``remote``/``remote-cluster``
+backend gives a true multi-host scan against shared storage nodes.
+"""
+
+from __future__ import annotations
+
+import base64
+import queue
+import threading
+from typing import Optional, Sequence
+
+from titan_tpu.errors import TemporaryBackendError
+from titan_tpu.olap.api import ScanMetrics
+from titan_tpu.olap.distributed import (ScanJobSpec, _merge_metrics,
+                                        _run_split, key_splits)
+from titan_tpu.utils.httpnode import JsonNode, json_call
+
+
+def _b(x: bytes) -> str:
+    return base64.b64encode(x).decode()
+
+
+def _ub(x: str) -> bytes:
+    return base64.b64decode(x)
+
+
+class ScanWorkerServer(JsonNode):
+    """One scan worker: executes shipped splits against its own graph
+    connection (opened per request from the shipped config)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(self._dispatch, host, port, name="scan-worker")
+
+    def _dispatch(self, path: str, req: dict):
+        if path == "/ping":
+            return {"ok": True}
+        if path == "/scan":
+            spec = ScanJobSpec(req["factory"], dict(req.get("kwargs") or {}))
+            key_range = (_ub(req["key_start"]), _ub(req["key_end"]))
+            counts = _run_split(dict(req["graph_config"]), spec, key_range,
+                                req.get("store", "edgestore"),
+                                int(req.get("num_threads", 2)))
+            return {"counts": {k: int(v) for k, v in counts.items()}}
+        raise ValueError(f"unknown path {path!r}")
+
+
+class RemoteScanRunner:
+    """Coordinator: dispatches key splits to HTTP scan workers with
+    failover. ``workers``: ["host:port", ...]."""
+
+    def __init__(self, workers: Sequence[str], graph_config: dict,
+                 store: str = "edgestore", threads_per_worker: int = 2,
+                 splits_per_worker: int = 2, timeout: float = 600.0):
+        if not workers:
+            raise ValueError("RemoteScanRunner needs at least one worker")
+        self.workers = [w if "://" in w else f"http://{w}" for w in workers]
+        self.graph_config = dict(graph_config)
+        self.store = store
+        self.threads_per_worker = threads_per_worker
+        self.splits_per_worker = splits_per_worker
+        self.timeout = timeout
+
+    def run(self, spec: ScanJobSpec, idm=None) -> ScanMetrics:
+        if idm is None:
+            import titan_tpu
+            g = titan_tpu.open(dict(self.graph_config))
+            try:
+                idm = g.idm
+            finally:
+                g.close()
+        splits = key_splits(idm,
+                            len(self.workers) * self.splits_per_worker)
+        pending: "queue.Queue" = queue.Queue()
+        for s in splits:
+            pending.put(s)
+        results: list[dict] = []
+        errors: list[BaseException] = []
+        done = threading.Event()
+        lock = threading.Lock()
+        remaining = [len(splits)]
+        alive = [len(self.workers)]
+
+        def serve(url: str):
+            """One drain loop per worker: keep polling until every split
+            has completed (another worker's failed split may be re-queued
+            AFTER this worker first sees an empty queue, so idle workers
+            must wait, not exit); a worker retires only on its own
+            failure (re-run-mapper semantics)."""
+            while not done.is_set():
+                try:
+                    key_range = pending.get(timeout=0.2)
+                except queue.Empty:
+                    with lock:
+                        hopeless = alive[0] == 0
+                    if hopeless:
+                        return
+                    continue
+                try:
+                    res = json_call(url, "/scan", {
+                        "graph_config": self.graph_config,
+                        "factory": spec.factory, "kwargs": spec.kwargs,
+                        "key_start": _b(key_range[0]),
+                        "key_end": _b(key_range[1]),
+                        "store": self.store,
+                        "num_threads": self.threads_per_worker,
+                    }, timeout=self.timeout)
+                except Exception as e:   # noqa: BLE001 — retire worker
+                    pending.put(key_range)
+                    with lock:
+                        errors.append(e)
+                        alive[0] -= 1
+                        if alive[0] == 0:
+                            done.set()   # no one left to drain the queue
+                    return
+                with lock:
+                    results.append(res["counts"])
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        done.set()
+
+        threads = [threading.Thread(target=serve, args=(u,), daemon=True)
+                   for u in self.workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if remaining[0] > 0:
+            raise TemporaryBackendError(
+                f"{remaining[0]} split(s) undispatchable; all workers "
+                f"failed (last errors: {[str(e) for e in errors[-3:]]})")
+        metrics = ScanMetrics()
+        for counts in results:
+            _merge_metrics(metrics, counts)
+        return metrics
+
+
+def distributed_reindex_remote(workers: Sequence[str], graph_config: dict,
+                               index_name: str) -> ScanMetrics:
+    """REINDEX across HTTP scan workers (the MapReduceIndexManagement
+    role at multi-host scale)."""
+    runner = RemoteScanRunner(workers, graph_config)
+    spec = ScanJobSpec("titan_tpu.olap.distributed:make_repair_job",
+                       {"index_name": index_name})
+    return runner.run(spec)
+
+
+def main(argv: Optional[list] = None) -> None:
+    import sys
+    args = list(sys.argv[1:] if argv is None else argv)
+    port = int(args[0]) if args else 0
+    host = args[1] if len(args) > 1 else "0.0.0.0"
+    node = ScanWorkerServer(host, port).start()
+    print(f"scan-worker serving on {node.url}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        node.stop()
+
+
+if __name__ == "__main__":
+    main()
