@@ -175,6 +175,19 @@ def gods_2hop() -> tuple[float, int]:
 def main() -> None:
     import jax
 
+    try:
+        # persist compiled executables across bench processes (first-run
+        # compiles go through the axon tunnel at ~10-60s per shape bucket)
+        import os
+        cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_cache", "xla")
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass
+
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     scale = int(sys.argv[1]) if len(sys.argv) > 1 else (26 if on_accel

@@ -1,0 +1,22 @@
+"""The generated config reference must match the option tree (docs can't
+drift from the single source of truth)."""
+
+import os
+
+
+def test_config_reference_in_sync():
+    from titan_tpu.config.docgen import render
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "config-reference.md")
+    with open(path) as f:
+        on_disk = f.read()
+    assert on_disk == render(), (
+        "docs/config-reference.md is stale — regenerate with "
+        "python -m titan_tpu.config.docgen > docs/config-reference.md")
+
+
+def test_reference_covers_all_namespaces():
+    from titan_tpu.config.docgen import render
+    md = render()
+    for ns in ("storage.cluster", "storage.lock", "ids", "graph"):
+        assert f"`{ns}`" in md or f"`{ns}." in md

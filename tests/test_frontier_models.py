@@ -189,3 +189,12 @@ def test_graph500_numpy_fallback(tmp_path, monkeypatch):
     deg = np.asarray(hb["deg"])
     colstart = np.asarray(hb["colstart"])
     assert int(colstart[-1]) == int((-(-deg.astype(np.int64) // 8)).sum())
+
+
+def test_pipelined_upload_matches_direct():
+    from titan_tpu.olap.tpu.graph500 import pipelined_upload
+    rng = np.random.default_rng(17)
+    for cols in (10, 64, 100, 129):
+        a = rng.integers(0, 1000, (8, cols)).astype(np.int32)
+        got = np.asarray(pipelined_upload(a, chunk_cols=32))
+        assert (got == a).all(), cols

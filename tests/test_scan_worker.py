@@ -126,3 +126,16 @@ def test_requeued_split_reaches_idle_worker(cluster):
         "titan_tpu.olap.jobs:make_vertex_count_job"))
     assert metrics.get(VertexCountJob.VERTICES) == 12
     assert metrics.get(VertexCountJob.EDGES) == 6
+
+
+def test_bad_job_spec_fails_fast_as_permanent(cluster):
+    """A permanently-broken job (unresolvable factory) must surface as
+    PermanentBackendError immediately, not as a retryable
+    'all workers failed' (review finding)."""
+    from titan_tpu.errors import PermanentBackendError
+    cfg, workers = cluster
+    _populate(cfg, n_people=2, n_edges=0)
+    runner = RemoteScanRunner(
+        [f"127.0.0.1:{w.port}" for w in workers], cfg)
+    with pytest.raises(PermanentBackendError):
+        runner.run(ScanJobSpec("titan_tpu.no_such_module:nope"))

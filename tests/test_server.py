@@ -31,11 +31,14 @@ def _get(s, path):
         return r.status, json.loads(r.read())
 
 
-def _post(s, path, payload):
+def _post(s, path, payload, token=None):
     req = urllib.request.Request(
         f"http://{s.host}:{s.port}{path}",
         data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
+        headers={"Content-Type": "application/json",
+                 **({"Authorization": f"Bearer {token}"} if token
+                    else {})},
+        method="POST")
     try:
         with urllib.request.urlopen(req, timeout=10) as r:
             return r.status, json.loads(r.read())
@@ -87,7 +90,8 @@ def test_bad_requests(server):
     code, body = _post(server, "/traversal", {"nope": 1})
     assert code == 400
     code, body = _post(server, "/traversal", {"gremlin": "g.V().bogus()"})
-    assert code == 500 and "error" in body
+    # caller-fault taxonomy: unknown step = AttributeError -> 400
+    assert code == 400 and "error" in body and body["retryable"] is False
     code, body = _get(server, "/status")   # server still alive after error
     assert code == 200
 
@@ -109,3 +113,89 @@ def test_from_yaml(tmp_path):
     finally:
         s.stop()
         s.graph.close()
+
+
+class _Addr:
+    def __init__(self, port):
+        self.host, self.port = "127.0.0.1", port
+
+
+def _post_script(port, script, token=None, path="/traversal"):
+    # thin wrapper over the module's _post helper (one wire-contract impl)
+    return _post(_Addr(port), path, {"gremlin": script}, token=token)
+
+
+def test_concurrent_mutating_sessions():
+    """VERDICT item 10: N threads mutate through the wire concurrently;
+    every write lands exactly once (per-thread bound txs commit per
+    request, Gremlin Server semantics)."""
+    import threading
+
+    import titan_tpu
+    from titan_tpu.server import GraphServer
+    g = titan_tpu.open("inmemory")
+    srv = GraphServer(g, port=0).start()
+    try:
+        errors = []
+
+        def writer(i):
+            for j in range(5):
+                code, body = _post_script(
+                    srv.port,
+                    f"graph.tx().add_vertex('person', name='w{i}_{j}')")
+                if code != 200:
+                    errors.append(body)
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        code, body = _post_script(srv.port, "g.V().has_label('person').count()")
+        assert code == 200 and body["result"] == [30]
+    finally:
+        srv.stop()
+        g.close()
+
+
+def test_wire_error_taxonomy():
+    import titan_tpu
+    from titan_tpu.server import GraphServer
+    g = titan_tpu.open("inmemory")
+    srv = GraphServer(g, port=0).start()
+    try:
+        # caller fault -> 400, retryable False
+        code, body = _post_script(srv.port, "this is not ( python")
+        assert code == 400 and body["retryable"] is False
+        assert body["type"] == "SyntaxError"
+        code, body = _post_script(srv.port, "nonexistent_binding.foo()")
+        assert code == 400 and body["type"] == "NameError"
+        # schema violation over the wire -> 400
+        code, body = _post_script(
+            srv.port,
+            "graph.management().make_property_key('x', object)")
+        assert code == 400 and body["retryable"] is False
+        # unknown path -> 404 envelope
+        code, body = _post_script(srv.port, "1", path="/nope")
+        assert code == 404 and body["type"] == "NotFound"
+    finally:
+        srv.stop()
+        g.close()
+
+
+def test_bearer_token_auth():
+    import titan_tpu
+    from titan_tpu.server import GraphServer
+    g = titan_tpu.open("inmemory")
+    srv = GraphServer(g, port=0, auth_token="s3cret").start()
+    try:
+        code, body = _post_script(srv.port, "g.V().count()")
+        assert code == 401 and body["type"] == "Unauthorized"
+        code, body = _post_script(srv.port, "g.V().count()", token="wrong")
+        assert code == 401
+        code, body = _post_script(srv.port, "g.V().count()", token="s3cret")
+        assert code == 200 and body["result"] == [0]
+    finally:
+        srv.stop()
+        g.close()

@@ -60,11 +60,13 @@ def slot_weights_np(slots: np.ndarray, min_w: float = 0.0,
     return (min_w + w_range * u).astype(np.float32)
 
 
-# above this frontier chunk mass, rounds run as dense window sweeps
-# (the enumeration path would materialize an [8, p_cap] block - 8.6GB at
-# p_cap=2^28 - on top of a 9GB scale-26 graph)
-DENSE_THRESHOLD_CHUNKS = 1 << 25
-DENSE_WINDOW = 1 << 24
+# above this frontier chunk mass, rounds run as dense window sweeps.
+# Both caps are sized so the [8, cap] working blocks (neighbors + message
+# + weight-hash temporaries, ~4 of them) stay ~1GB: at scale 26 the graph
+# itself holds 9GB of the 16GB HBM and the enumeration path OOMed with
+# 2^25 pair caps.
+DENSE_THRESHOLD_CHUNKS = 1 << 23
+DENSE_WINDOW = 1 << 22
 
 
 def _colowner(g):

@@ -24,7 +24,7 @@ import queue
 import threading
 from typing import Optional, Sequence
 
-from titan_tpu.errors import TemporaryBackendError
+from titan_tpu.errors import PermanentBackendError, TemporaryBackendError
 from titan_tpu.olap.api import ScanMetrics
 from titan_tpu.olap.distributed import (ScanJobSpec, _merge_metrics,
                                         _run_split, key_splits)
@@ -90,6 +90,7 @@ class RemoteScanRunner:
             pending.put(s)
         results: list[dict] = []
         errors: list[BaseException] = []
+        fatal: list[BaseException] = []
         done = threading.Event()
         lock = threading.Lock()
         remaining = [len(splits)]
@@ -100,15 +101,13 @@ class RemoteScanRunner:
             has completed (another worker's failed split may be re-queued
             AFTER this worker first sees an empty queue, so idle workers
             must wait, not exit); a worker retires only on its own
-            failure (re-run-mapper semantics)."""
+            failure (re-run-mapper semantics). A PermanentBackendError is
+            the JOB's fault (e.g. an unresolvable factory) — retrying on
+            other workers cannot help, so the whole run aborts."""
             while not done.is_set():
                 try:
                     key_range = pending.get(timeout=0.2)
                 except queue.Empty:
-                    with lock:
-                        hopeless = alive[0] == 0
-                    if hopeless:
-                        return
                     continue
                 try:
                     res = json_call(url, "/scan", {
@@ -119,6 +118,11 @@ class RemoteScanRunner:
                         "store": self.store,
                         "num_threads": self.threads_per_worker,
                     }, timeout=self.timeout)
+                except PermanentBackendError as e:
+                    with lock:
+                        fatal.append(e)
+                        done.set()
+                    return
                 except Exception as e:   # noqa: BLE001 — retire worker
                     pending.put(key_range)
                     with lock:
@@ -139,6 +143,8 @@ class RemoteScanRunner:
             t.start()
         for t in threads:
             t.join()
+        if fatal:
+            raise fatal[0]
         if remaining[0] > 0:
             raise TemporaryBackendError(
                 f"{remaining[0]} split(s) undispatchable; all workers "

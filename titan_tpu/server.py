@@ -57,13 +57,44 @@ def jsonify(obj: Any, max_depth: int = 4) -> Any:
     return str(obj)
 
 
-class GraphServer:
-    """Hosts one open graph; evaluate() is the script-engine seam."""
+def wire_error(e: BaseException) -> tuple[int, dict]:
+    """Exception -> (HTTP status, error envelope): the wire taxonomy.
 
-    def __init__(self, graph, host: str = "127.0.0.1", port: int = 8182):
+    Mirrors the backend exception taxonomy (reference: Temporary vs
+    PermanentBackendException + BackendOperation retry semantics): 503 =
+    retryable backend trouble, 400 = the caller's request is at fault,
+    500 = server-side permanent. ``retryable`` tells clients whether the
+    same request may succeed later."""
+    from titan_tpu.errors import (InvalidElementError,
+                                  PermanentBackendError,
+                                  SchemaViolationError,
+                                  TemporaryBackendError)
+    name = type(e).__name__
+    env = {"error": str(e) or name, "type": name}
+    if isinstance(e, TemporaryBackendError):
+        return 503, {**env, "retryable": True}
+    if isinstance(e, (SchemaViolationError, InvalidElementError,
+                      SyntaxError, NameError, TypeError, ValueError,
+                      KeyError, AttributeError)):
+        return 400, {**env, "retryable": False}
+    if isinstance(e, PermanentBackendError):
+        return 500, {**env, "retryable": False}
+    return 500, {**env, "retryable": False}
+
+
+class GraphServer:
+    """Hosts one open graph; evaluate() is the script-engine seam.
+
+    ``auth_token``: when set, every request must carry
+    ``Authorization: Bearer <token>`` (401 otherwise) — the minimal
+    credential gate for a script-evaluating endpoint."""
+
+    def __init__(self, graph, host: str = "127.0.0.1", port: int = 8182,
+                 auth_token: Optional[str] = None):
         self.graph = graph
         self.host = host
         self.port = port
+        self.auth_token = auth_token
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -107,14 +138,29 @@ class GraphServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _authorized(self) -> bool:
+                if server.auth_token is None:
+                    return True
+                import hmac
+                got = self.headers.get("Authorization", "")
+                if hmac.compare_digest(got,
+                                       f"Bearer {server.auth_token}"):
+                    return True
+                self._send(401, {"error": "missing or bad bearer token",
+                                 "type": "Unauthorized",
+                                 "retryable": False})
+                return False
+
             def do_GET(self):
+                if not self._authorized():
+                    return
                 try:
                     self._do_get()
                 except BaseException as e:
                     # same JSON-error contract as /traversal — never drop
                     # the connection on a backend hiccup
                     try:
-                        self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                        self._send(*wire_error(e))
                     except OSError:
                         pass
 
@@ -141,8 +187,12 @@ class GraphServer:
                     self._send(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):
+                if not self._authorized():
+                    return
                 if self.path != "/traversal":
-                    self._send(404, {"error": f"unknown path {self.path}"})
+                    self._send(404, {"error": f"unknown path {self.path}",
+                                     "type": "NotFound",
+                                     "retryable": False})
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 try:
@@ -150,12 +200,14 @@ class GraphServer:
                     script = req["gremlin"]
                 except (json.JSONDecodeError, KeyError):
                     self._send(400, {"error": "body must be JSON with a "
-                                              "'gremlin' field"})
+                                              "'gremlin' field",
+                                     "type": "BadRequest",
+                                     "retryable": False})
                     return
                 try:
                     result = server.evaluate(script)
                 except BaseException as e:
-                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    self._send(*wire_error(e))
                     return
                 self._send(200, {"result": jsonify(result)})
 
@@ -182,7 +234,8 @@ def from_yaml(path: str) -> GraphServer:
         cfg = yaml.safe_load(f) or {}
     graph = titan_tpu.open(cfg.get("graph") or {})
     return GraphServer(graph, host=cfg.get("host", "127.0.0.1"),
-                       port=int(cfg.get("port", 8182)))
+                       port=int(cfg.get("port", 8182)),
+                       auth_token=cfg.get("auth-token"))
 
 
 def console(config) -> None:
