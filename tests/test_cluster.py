@@ -271,3 +271,54 @@ def test_key_consistent_flag_honesty():
     finally:
         for s in servers:
             s.stop()
+
+
+def test_tombstone_compaction(nodes):
+    mgr = ClusterStoreManager(hosts_of(nodes), replication=2,
+                              virtual_nodes=16)
+    store = mgr.open_database("s")
+    txh = mgr.begin_transaction()
+    for i in range(10):
+        store.mutate(b"k%d" % i, [Entry(b"c", b"v")], [], txh)
+    for i in range(10):
+        store.mutate(b"k%d" % i, [], [b"c"], txh)       # tombstones
+    purged = mgr.compact_tombstones(["s"], grace_seconds=0.0)
+    assert purged >= 10                                 # rf=2 -> ~20
+    # post-compaction reads are still clean
+    for i in range(10):
+        assert store.get_slice(KeySliceQuery(b"k%d" % i, SliceQuery()),
+                               txh) == []
+    # compaction refuses to run with a replica down
+    nodes[0].stop()
+    with pytest.raises(TemporaryBackendError):
+        mgr.compact_tombstones(["s"])
+
+
+def test_concurrent_writers_converge(nodes):
+    """VERDICT weak point 6: concurrent writers through two coordinators;
+    LWW cells make the replicas agree on the final value."""
+    import threading
+    m1 = ClusterStoreManager(hosts_of(nodes), replication=2,
+                             virtual_nodes=16, read_repair=1.0,
+                             write_consistency="quorum")
+    m2 = ClusterStoreManager(hosts_of(nodes), replication=2,
+                             virtual_nodes=16, read_repair=1.0,
+                             write_consistency="quorum")
+    txh = m1.begin_transaction()
+
+    def writer(mgr, who):
+        s = mgr.open_database("s")
+        for i in range(30):
+            s.mutate(b"contended", [Entry(b"c", b"%s-%d" % (who, i))],
+                     [], txh)
+
+    t1 = threading.Thread(target=writer, args=(m1, b"a"))
+    t2 = threading.Thread(target=writer, args=(m2, b"b"))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    s1 = m1.open_database("s")
+    s2 = m2.open_database("s")
+    v1 = s1.get_slice(KeySliceQuery(b"contended", SliceQuery()), txh)
+    v2 = s2.get_slice(KeySliceQuery(b"contended", SliceQuery()), txh)
+    # both coordinators see the SAME single winning cell
+    assert v1 == v2 and len(v1) == 1
+    assert v1[0].value.endswith(b"-29")

@@ -37,11 +37,13 @@ claim protocol on top and treats the store as eventually consistent):
   and yield a key only from its first ALIVE replica (per-replica best
   effort, like the reference's eventually-consistent bulk scans).
 
-Known limits (documented): tombstones are retained indefinitely (no
-gc_grace compaction yet); a column-limited slice can return fewer than
-``limit`` live columns when a tombstone superseded a fetched column
-(the classic Cassandra short-read); hint queues are bounded
-(spill converges later via read repair).
+Known limits (documented): tombstones persist until an operator runs
+``compact_tombstones`` (the gc_grace compaction role; it requires every
+replica up so a purged tombstone cannot un-suppress a stale cell); a
+column-limited slice can return fewer than ``limit`` live columns when a
+tombstone superseded a fetched column (the classic Cassandra
+short-read); hint queues are bounded (spill converges later via read
+repair).
 """
 
 from __future__ import annotations
@@ -339,7 +341,7 @@ class ClusterStore(KeyColumnValueStore):
         node would otherwise only surface mid-merge); a node dying
         MID-scan raises TemporaryBackendError for the caller's retry
         loop."""
-        alive = [p for p in range(self._m.num_peers) if self._m.probe(p)]
+        alive = self._m.probe_all()
         self._m.require_scan_coverage(alive)
         iters = []
         for p in alive:
@@ -375,7 +377,7 @@ class ClusterStore(KeyColumnValueStore):
                 yield run_key, live
 
     def _unordered_scan(self, query: SliceQuery, txh) -> Iterator:
-        alive = [p for p in range(self._m.num_peers) if self._m.probe(p)]
+        alive = self._m.probe_all()
         self._m.require_scan_coverage(alive)
         alive_set = set(alive)
         for p in alive:
@@ -546,6 +548,15 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
             self.mark_down(p)
             return False
 
+    def probe_all(self) -> list[int]:
+        """Probe every peer CONCURRENTLY (a scan start previously paid
+        num_peers serial HTTP round trips — worst case num_peers x the
+        connect timeout when nodes are down)."""
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(16, self.num_peers)) as ex:
+            up = list(ex.map(self.probe, range(self.num_peers)))
+        return [p for p, ok in enumerate(up) if ok]
+
     # -- manager SPI ---------------------------------------------------------
 
     @property
@@ -632,6 +643,36 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
         for mgr in self._peers:
             if mgr is not None:
                 mgr.close()
+
+    def compact_tombstones(self, store_names: Sequence[str],
+                           grace_seconds: float = 0.0) -> int:
+        """Tombstone GC (the Cassandra gc_grace compaction role): delete
+        tombstone cells older than ``grace_seconds`` from every reachable
+        replica. Requires ALL replicas up (a down replica could still
+        hold a stale live cell that the purged tombstone was suppressing
+        — purging early would resurrect it on revival). Returns the
+        number of tombstone cells purged."""
+        alive = [p for p in range(self.num_peers) if self.probe(p)]
+        if len(alive) < self.num_peers:
+            raise TemporaryBackendError(
+                "tombstone compaction needs every replica up (a down "
+                "replica may hold stale cells the tombstones suppress)")
+        cutoff = time.time_ns() - int(grace_seconds * 1e9)
+        txh = StoreTransaction(None)
+        purged = 0
+        for name in store_names:
+            for p in alive:
+                store = self.peer(p).open_database(name)
+                for key, entries in store.get_keys(SliceQuery(), txh):
+                    dead = []
+                    for e in entries:
+                        ts, tomb, _, _ = _unwrap(e.value)
+                        if tomb and ts < cutoff:
+                            dead.append(e.column)
+                    if dead:
+                        store.mutate(key, [], dead, txh)
+                        purged += len(dead)
+        return purged
 
     def clear_storage(self) -> None:
         for p in range(self.num_peers):
