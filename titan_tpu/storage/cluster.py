@@ -419,6 +419,7 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
         self._rng = random.Random(0xA57B)
         self._ts_lock = threading.Lock()
         self._last_ts = 0
+        self._features_lock = threading.Lock()
         self._hints: dict[int, list[tuple[str, bytes, KCVMutation]]] = {}
         self._hints_lock = threading.Lock()
         self._hint_overflow: set[int] = set()
@@ -474,7 +475,11 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
                     from e
             self._peers[p] = mgr
             self._down.discard(p)
-            self._cell_ttl = self._cell_ttl and mgr.features.cell_ttl
+            # probe_all connects peers concurrently; an unlocked
+            # read-modify-write here could lose a False from a
+            # non-TTL-capable peer
+            with self._features_lock:
+                self._cell_ttl = self._cell_ttl and mgr.features.cell_ttl
             self._replay_hints(p, mgr)
         return mgr
 
@@ -647,16 +652,26 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
     def compact_tombstones(self, store_names: Sequence[str],
                            grace_seconds: float = 0.0) -> int:
         """Tombstone GC (the Cassandra gc_grace compaction role): delete
-        tombstone cells older than ``grace_seconds`` from every reachable
-        replica. Requires ALL replicas up (a down replica could still
-        hold a stale live cell that the purged tombstone was suppressing
-        — purging early would resurrect it on revival). Returns the
-        number of tombstone cells purged."""
-        alive = [p for p in range(self.num_peers) if self.probe(p)]
+        tombstone cells older than ``grace_seconds`` from every replica.
+
+        A maintenance operation for quiescent windows (like nodetool
+        compact): refuses to run unless every replica is up AND no hint
+        queue has ever overflowed — in either case some replica may hold
+        a stale live cell that a purged tombstone was suppressing, and
+        purging would resurrect it. Concurrent writers narrow-race the
+        purge (the delete is not compare-and-set), so each candidate
+        column is re-read immediately before deletion and skipped if the
+        cell changed. Returns the number of tombstone cells purged."""
+        alive = self.probe_all()
         if len(alive) < self.num_peers:
             raise TemporaryBackendError(
                 "tombstone compaction needs every replica up (a down "
                 "replica may hold stale cells the tombstones suppress)")
+        with self._hints_lock:
+            if self._hint_overflow or self._hints:
+                raise TemporaryBackendError(
+                    "tombstone compaction refused: undelivered/overflowed "
+                    "hints mean a replica may still be missing tombstones")
         cutoff = time.time_ns() - int(grace_seconds * 1e9)
         txh = StoreTransaction(None)
         purged = 0
@@ -664,11 +679,19 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
             for p in alive:
                 store = self.peer(p).open_database(name)
                 for key, entries in store.get_keys(SliceQuery(), txh):
-                    dead = []
+                    cand = {}
                     for e in entries:
                         ts, tomb, _, _ = _unwrap(e.value)
                         if tomb and ts < cutoff:
-                            dead.append(e.column)
+                            cand[e.column] = e.value
+                    if not cand:
+                        continue
+                    # re-read just before the purge: only delete cells
+                    # still byte-identical to the observed tombstone
+                    fresh = {e.column: e.value for e in store.get_slice(
+                        KeySliceQuery(key, SliceQuery()), txh)}
+                    dead = [col for col, v in cand.items()
+                            if fresh.get(col) == v]
                     if dead:
                         store.mutate(key, [], dead, txh)
                         purged += len(dead)
