@@ -322,3 +322,40 @@ def test_concurrent_writers_converge(nodes):
     # both coordinators see the SAME single winning cell
     assert v1 == v2 and len(v1) == 1
     assert v1[0].value.endswith(b"-29")
+
+
+def test_hint_overflow_forces_merged_reads_until_full_sync(nodes,
+                                                          monkeypatch):
+    """Spilled hints may include tombstones: merged reads stay forced
+    (reconnect alone must not clear the taint) until compact_tombstones
+    runs a full anti-entropy pass, which also delivers the missed data."""
+    import titan_tpu.storage.cluster as C
+    monkeypatch.setattr(C, "MAX_HINTS_PER_PEER", 1)
+    mgr = ClusterStoreManager(hosts_of(nodes), replication=3,
+                              write_consistency="quorum", virtual_nodes=16,
+                              read_repair=0.0)
+    store = mgr.open_database("s")
+    txh = mgr.begin_transaction()
+    store.mutate(b"seed", [Entry(b"c", b"0")], [], txh)
+    victim = mgr.ring.replicas(b"k0")[0]
+    nodes[victim].stop()
+    for i in range(4):                      # 1 hint queued, 3 spilled
+        store.mutate(b"k%d" % i, [Entry(b"c", b"v%d" % i)], [], txh)
+    assert mgr._ever_overflowed == {victim}
+    nodes[victim] = restart(nodes[victim])
+    assert mgr.is_up(victim)                # replays the 1 queued hint
+    # taint survives reconnect; merged reads forced despite read_repair=0
+    assert mgr._ever_overflowed == {victim}
+    assert mgr.repair_roll() is True
+    purged = mgr.compact_tombstones(["s"])  # full sync heals everything
+    assert mgr._ever_overflowed == set()
+    # prove the victim now holds ALL keys: kill the other replicas
+    for p in range(3):
+        if p != victim:
+            nodes[p].stop()
+    solo = ClusterStoreManager([hosts_of(nodes)[victim]], replication=1,
+                               virtual_nodes=16)
+    s2 = solo.open_database("s")
+    for i in range(4):
+        assert s2.get_slice(KeySliceQuery(b"k%d" % i, SliceQuery()),
+                            txh) == [Entry(b"c", b"v%d" % i)]

@@ -58,8 +58,6 @@ ALPHA = 8.0
 # after this many 8-edge chunks checked per candidate, survivors go to the
 # exhaustive sweep
 BU_CHUNK_ROUNDS = 8
-# fused device rounds per host step (readbacks are ~95ms each)
-BU_FUSE = 4
 
 
 def build_chunked_csr(snap):
@@ -198,15 +196,26 @@ def _bu_rounds():
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
-                           static_argnames=("c_cap", "n_", "fuse"),
+                           static_argnames=("c_cap", "src_cap", "n_",
+                                            "fuse"),
                            donate_argnums=(0,))
-        def bu(dist, cand, off, c_count, level, dstT, colstart, degc,
-               c_cap: int, n_: int, fuse: int):
-            """``fuse`` chunk-check rounds over the active candidate list.
+        def bu(dist, cand, off, c_count, cand_level, c_level_count, level,
+               dstT, colstart, degc, c_cap: int, src_cap: int, n_: int,
+               fuse: int):
+            """``fuse`` chunk-check rounds over the active candidate list,
+            PLUS the level-end wrap outputs (next level's candidate list +
+            mode-decision stats) computed unconditionally — when no
+            survivors remain the host skips the separate wrap call, one
+            fewer ~95ms tunnel sync per bottom-up level. The wrap is
+            discarded when survivors remain (typically once, on the heavy
+            level's first dispatch): ~tens of ms of n-scale reductions
+            wasted there vs a sync saved on every straggler-free level —
+            measured net win; revisit if src_cap compile variants bloat.
 
             cand: [c_cap] vertex ids (pad n_), off: [c_cap] chunk progress.
             Found candidates get dist=level+1 and drop out; exhausted
             candidates (all chunks checked, no hit) drop out too.
+            cand_level: [src_cap] the level's full candidate list.
             """
             q_pad = dstT.shape[1] - 1
 
@@ -237,7 +246,22 @@ def _bu_rounds():
             v = jnp.minimum(cand, n_)
             rem = jnp.where(alive, jnp.maximum(degc[v] - off, 0), 0) \
                 .sum(dtype=jnp.int32)
-            return dist, cand, off, jnp.stack([c_count, rem])
+            # fused level-end wrap (valid when c_count == 0)
+            lvalid = jnp.arange(src_cap) < c_level_count
+            lv = jnp.minimum(cand_level, n_)
+            unvis = lvalid & (dist[lv] >= INF) & (degc[lv] > 0)
+            idx = jnp.nonzero(unvis, size=src_cap,
+                              fill_value=src_cap - 1)[0]
+            nc = unvis.sum().astype(jnp.int32)
+            keep = jnp.arange(src_cap) < nc
+            cand_next = jnp.where(keep, lv[idx], n_).astype(jnp.int32)
+            changed = dist[:n_] == level + 1
+            nf = changed.sum().astype(jnp.int32)
+            m8_next = jnp.where(changed, degc[:n_], 0).sum(dtype=jnp.int32)
+            m8_unvis = jnp.where(dist[:n_] >= INF, degc[:n_], 0) \
+                .sum(dtype=jnp.int32)
+            return dist, cand, off, cand_next, jnp.stack(
+                [c_count, rem, nc, nf, m8_next, m8_unvis])
         return bu
     return _get("hybrid_bu", build)
 
@@ -402,20 +426,28 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
             c_count = int(c_count)
             active = cand
             a_count = c_count
+            src_cap = min(_next_pow2(max(c_count, 2)), cap_n)
             off = jnp.zeros(active.shape, jnp.int32)
             rounds = 0
             rem_total = total_chunks
+            wrap_stats = None
             while a_count > 0 and rounds < BU_CHUNK_ROUNDS:
                 c_cap = min(_next_pow2(max(a_count, 2)), cap_n)
-                # first call checks ONE chunk: most candidates are decided
-                # by it on power-law graphs, so later (fused) rounds run
-                # at the surviving width instead of the full level width
-                fuse = 1 if rounds == 0 else BU_FUSE
-                dist, active, off, st = bu(
+                # first call checks ONE chunk (most candidates are decided
+                # by it on power-law graphs, so later rounds run at the
+                # surviving width); the second covers every remaining
+                # round in one dispatch
+                fuse = 1 if rounds == 0 else BU_CHUNK_ROUNDS - rounds
+                dist, active, off, cand_next, st = bu(
                     dist, active[:c_cap], off[:c_cap], jnp.int32(a_count),
-                    jnp.int32(level), dstT, colstart, degc,
-                    c_cap=c_cap, n_=n, fuse=fuse)
-                a_count, rem_total = (int(x) for x in np.asarray(st))
+                    cand[:src_cap], jnp.int32(c_count), jnp.int32(level),
+                    dstT, colstart, degc, c_cap=c_cap, src_cap=src_cap,
+                    n_=n, fuse=fuse)
+                sth = [int(x) for x in np.asarray(st)]
+                a_count, rem_total = sth[0], sth[1]
+                if a_count == 0:
+                    wrap_stats = (cand_next, sth[2], sth[3], sth[4],
+                                  sth[5])
                 rounds += fuse
             if a_count > 0:
                 # exhaustive sweep for the stragglers
@@ -425,16 +457,19 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
                           jnp.int32(a_count), jnp.int32(level), dstT,
                           colstart, degc, c_cap=c_cap, p_cap=rem_cap,
                           n_=n)
-            # fused level end: next candidate list + scalar stats (the
-            # frontier list is rebuilt lazily on a bu->td switch)
-            src_cap = min(_next_pow2(max(c_count, 2)), cap_n)
-            cand, st = buwrap(dist, cand[:src_cap], jnp.int32(c_count),
-                              jnp.int32(level), degc, n_=n,
-                              src_cap=src_cap)
-            cand = pad(cand)
+                wrap_stats = None     # dist changed after the fused wrap
+            if wrap_stats is not None:
+                cand, c_count, f_count, m8_f, m8_unvis = wrap_stats
+                cand = pad(cand)
+            else:
+                # stragglers ran: recompute the level end from final dist
+                cand, st = buwrap(dist, cand[:src_cap],
+                                  jnp.int32(c_count), jnp.int32(level),
+                                  degc, n_=n, src_cap=src_cap)
+                cand = pad(cand)
+                c_count, f_count, m8_f, m8_unvis = \
+                    (int(x) for x in np.asarray(st))
             frontier = None
-            c_count, f_count, m8_f, m8_unvis = \
-                (int(x) for x in np.asarray(st))
         level += 1
     out = dist[:n]
     if not return_device:

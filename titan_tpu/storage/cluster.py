@@ -38,12 +38,13 @@ claim protocol on top and treats the store as eventually consistent):
   effort, like the reference's eventually-consistent bulk scans).
 
 Known limits (documented): tombstones persist until an operator runs
-``compact_tombstones`` (the gc_grace compaction role; it requires every
-replica up so a purged tombstone cannot un-suppress a stale cell); a
-column-limited slice can return fewer than ``limit`` live columns when a
-tombstone superseded a fetched column (the classic Cassandra
-short-read); hint queues are bounded (spill converges later via read
-repair).
+``compact_tombstones`` (a full anti-entropy sync + gc_grace purge; it
+requires every replica up so a purged tombstone cannot un-suppress a
+stale cell); a column-limited slice can return fewer than ``limit`` live
+columns when a tombstone superseded a fetched column (the classic
+Cassandra short-read); hint queues are bounded — after an overflow the
+peer is tainted and ALL reads merge replicas until the next full sync
+clears it.
 """
 
 from __future__ import annotations
@@ -422,7 +423,11 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
         self._features_lock = threading.Lock()
         self._hints: dict[int, list[tuple[str, bytes, KCVMutation]]] = {}
         self._hints_lock = threading.Lock()
-        self._hint_overflow: set[int] = set()
+        # peers whose hint queue EVER overflowed: dropped hints may
+        # include tombstones, so tombstone compaction is unsafe until a
+        # full anti-entropy pass has run (compact_tombstones performs
+        # one); reconnect alone must NOT clear this
+        self._ever_overflowed: set[int] = set()
         self.ring = HashRing(len(self._addrs), max(1, int(replication)),
                              int(virtual_nodes), self._peer_ids)
         self._stores: dict[str, ClusterStore] = {}
@@ -450,9 +455,11 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
             return ts
 
     def repair_roll(self) -> bool:
-        # a peer whose hint queue overflowed can only converge through
-        # read repair, so force merged reads until it catches up
-        if self._hint_overflow:
+        # a peer whose hint queue EVER overflowed holds unknown staleness
+        # until a full anti-entropy pass (compact_tombstones) heals it —
+        # reconnect alone replays only the queued, non-spilled hints — so
+        # merged reads stay forced for the whole window
+        if self._ever_overflowed:
             return True
         return self._read_repair > 0 and \
             self._rng.random() < self._read_repair
@@ -488,7 +495,6 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
         was down. LWW cells make replay safe in any order/interleaving."""
         with self._hints_lock:
             queued = self._hints.pop(p, None)
-            self._hint_overflow.discard(p)
         if not queued:
             return
         muts: dict[str, dict[bytes, KCVMutation]] = {}
@@ -515,8 +521,9 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
         with self._hints_lock:
             q = self._hints.setdefault(p, [])
             if len(q) >= MAX_HINTS_PER_PEER:
-                # spilled hints converge later via read repair
-                self._hint_overflow.add(p)
+                # spilled hints converge later via forced merged reads +
+                # the next full anti-entropy pass
+                self._ever_overflowed.add(p)
                 return
             q.append((store_name, key, mut))
 
@@ -651,34 +658,53 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
 
     def compact_tombstones(self, store_names: Sequence[str],
                            grace_seconds: float = 0.0) -> int:
-        """Tombstone GC (the Cassandra gc_grace compaction role): delete
-        tombstone cells older than ``grace_seconds`` from every replica.
+        """Full anti-entropy pass + tombstone GC (the Cassandra repair +
+        gc_grace compaction roles): first every key is LWW-merged across
+        all replicas and stale replicas repaired — this DELIVERS any
+        tombstones a replica missed, including hints dropped by queue
+        overflow — then tombstone cells older than ``grace_seconds`` are
+        deleted everywhere.
 
         A maintenance operation for quiescent windows (like nodetool
-        compact): refuses to run unless every replica is up AND no hint
-        queue has ever overflowed — in either case some replica may hold
-        a stale live cell that a purged tombstone was suppressing, and
-        purging would resurrect it. Concurrent writers narrow-race the
-        purge (the delete is not compare-and-set), so each candidate
-        column is re-read immediately before deletion and skipped if the
-        cell changed. Returns the number of tombstone cells purged."""
+        repair/compact): refuses to run unless every replica is up (a
+        down replica cannot be synced, and purging its suppressing
+        tombstones would resurrect its stale cells on revival), and
+        refuses while undelivered hints are queued. Concurrent writers
+        narrow-race the purge (the delete is not compare-and-set), so
+        each candidate column is re-read immediately before deletion and
+        skipped if the cell changed. Returns the number of tombstone
+        cells purged."""
         alive = self.probe_all()
         if len(alive) < self.num_peers:
             raise TemporaryBackendError(
                 "tombstone compaction needs every replica up (a down "
                 "replica may hold stale cells the tombstones suppress)")
         with self._hints_lock:
-            if self._hint_overflow or self._hints:
+            if self._hints:
                 raise TemporaryBackendError(
-                    "tombstone compaction refused: undelivered/overflowed "
-                    "hints mean a replica may still be missing tombstones")
+                    "tombstone compaction refused: undelivered hints mean "
+                    "a replica may still be missing tombstones")
         cutoff = time.time_ns() - int(grace_seconds * 1e9)
         txh = StoreTransaction(None)
         purged = 0
         for name in store_names:
+            store = self.open_database(name)
+            # phase 1 — full sync: union of keys over all replicas, each
+            # merged + repaired (missed tombstones land here)
+            keys: set[bytes] = set()
             for p in alive:
-                store = self.peer(p).open_database(name)
-                for key, entries in store.get_keys(SliceQuery(), txh):
+                raw = self.peer(p).open_database(name)
+                for key, _ in raw.get_keys(SliceQuery(), txh):
+                    keys.add(key)
+            for key in keys:
+                rows = store._read_replicas(
+                    KeySliceQuery(key, SliceQuery()), txh)
+                _, repairs = _merge_cells(rows)
+                store._apply_repairs({None: repairs}, {None: key}, txh)
+            # phase 2 — purge expired tombstones from every replica
+            for p in alive:
+                raw = self.peer(p).open_database(name)
+                for key, entries in raw.get_keys(SliceQuery(), txh):
                     cand = {}
                     for e in entries:
                         ts, tomb, _, _ = _unwrap(e.value)
@@ -688,13 +714,17 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
                         continue
                     # re-read just before the purge: only delete cells
                     # still byte-identical to the observed tombstone
-                    fresh = {e.column: e.value for e in store.get_slice(
+                    fresh = {e.column: e.value for e in raw.get_slice(
                         KeySliceQuery(key, SliceQuery()), txh)}
                     dead = [col for col, v in cand.items()
                             if fresh.get(col) == v]
                     if dead:
-                        store.mutate(key, [], dead, txh)
+                        raw.mutate(key, [], dead, txh)
                         purged += len(dead)
+        # every key on every replica is now synced: the overflow taint is
+        # legitimately cleared
+        with self._hints_lock:
+            self._ever_overflowed.clear()
         return purged
 
     def clear_storage(self) -> None:
