@@ -110,27 +110,26 @@ def test_frontier_wcc_matches_union_find(seed):
 
 
 @pytest.mark.parametrize("kind", ["sssp", "wcc"])
-def test_dense_window_mode_matches_enumeration(kind, monkeypatch):
-    """Force the dense window sweep (used at scale-26 chunk masses) and
-    check it produces the same fixpoint as the enumeration path."""
+def test_budget_sliced_rounds_match_single_slice(kind, monkeypatch):
+    """Force tiny slice budgets (the scale-26 memory-bound regime: many
+    slices per round, incl. forced single-hub slices) and check the
+    fixpoint matches the single-slice run."""
     rng = np.random.default_rng(11)
     n = 200
     snap = sym_snap(rng, n, 700)
-    monkeypatch.setattr(F, "DENSE_THRESHOLD_CHUNKS", 0)
-    monkeypatch.setattr(F, "DENSE_WINDOW", 16)
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
     if kind == "wcc":
-        got_dense, _ = F.frontier_wcc(snap)
+        ref, _ = F.frontier_wcc(snap)
     else:
-        source = int(np.flatnonzero(snap.out_degree > 0)[0])
-        got_dense, _ = F.frontier_sssp(snap, source)
-    monkeypatch.setattr(F, "DENSE_THRESHOLD_CHUNKS", 1 << 25)
+        ref, _ = F.frontier_sssp(snap, source)
+    monkeypatch.setattr(F, "SLICE_BUDGET_CHUNKS", 2)
     if kind == "wcc":
-        got_enum, _ = F.frontier_wcc(snap)
-        assert (np.asarray(got_dense) == np.asarray(got_enum)).all()
+        got, _ = F.frontier_wcc(snap)
+        assert (np.asarray(got) == np.asarray(ref)).all()
     else:
-        got_enum, _ = F.frontier_sssp(snap, source)
-        assert np.asarray(got_dense) == pytest.approx(
-            np.asarray(got_enum), rel=1e-6)
+        got, _ = F.frontier_sssp(snap, source)
+        assert np.asarray(got) == pytest.approx(np.asarray(ref),
+                                                rel=1e-6)
 
 
 def test_pagerank_dense_matches_numpy_reference():

@@ -60,12 +60,18 @@ def slot_weights_np(slots: np.ndarray, min_w: float = 0.0,
     return (min_w + w_range * u).astype(np.float32)
 
 
-# above this frontier chunk mass, rounds run as dense window sweeps.
-# Both caps are sized so the [8, cap] working blocks (neighbors + message
-# + weight-hash temporaries, ~4 of them) stay ~1GB: at scale 26 the graph
-# itself holds 9GB of the 16GB HBM and the enumeration path OOMed with
-# 2^25 pair caps.
-DENSE_THRESHOLD_CHUNKS = 1 << 23
+# per-slice chunk budget: caps the [8, p_cap] working blocks (neighbors +
+# message + weight-hash temporaries, ~4 of them) at ~1GB — at scale 26
+# the graph itself holds 9GB of the 16GB HBM, and unbounded pair caps
+# OOMed. Rounds whose frontier mass exceeds the budget are processed as
+# multiple slices planned ON DEVICE (one boundary readback per round),
+# so total work tracks the ACTUAL relaxation mass — a dense all-slot
+# sweep at scale 26 paid 2.15B scatters per round regardless of activity
+# and took ~28s/round.
+SLICE_BUDGET_CHUNKS = 1 << 23
+SLICE_K_MAX = 128
+# legacy dense-window machinery (kept for pagerank_dense, where every
+# vertex IS active every iteration and slot padding is the only waste)
 DENSE_WINDOW = 1 << 22
 
 
@@ -90,184 +96,156 @@ def _colowner(g):
     return co
 
 
-def _dense_step(kind: str):
-    """One WINDOW of a dense sweep: relax every column in
-    [w0, w0+W) whose owner improved last round. No readback."""
+def _wrap_plan(kind: str):
+    """Round end, fused into ONE readback: the new frontier (vertices
+    whose value improved vs ``val_old``), the round's stats, and the
+    SLICE PLAN for the next round — frontier-index boundaries placed
+    every SLICE_BUDGET_CHUNKS of cumulative chunk mass (device
+    searchsorted), so the host sizes each slice's kernel without extra
+    syncs. A slice may exceed the budget by at most one vertex's chunks
+    (p_cap adds max_degc)."""
     def build():
         import jax
         import jax.numpy as jnp
 
-        @functools.partial(jax.jit, static_argnames=("W", "n_"),
+        @functools.partial(jax.jit,
+                           static_argnames=("n_", "cap", "k_max",
+                                            "budget"))
+        def wrapplan(val, val_old, degc, fb0, n_: int, cap: int,
+                     k_max: int, budget: int):
+            changed = val[:n_] < val_old[:n_]
+            nf = changed.sum().astype(jnp.int32)
+            frontier = jnp.nonzero(
+                changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
+            if cap > n_:
+                frontier = jnp.concatenate(
+                    [frontier, jnp.full((cap - n_,), n_, jnp.int32)])
+            cdeg = jnp.where(jnp.arange(cap) < nf,
+                             degc[jnp.minimum(frontier, n_)], 0)
+            cum = jnp.cumsum(cdeg)
+            m8 = jnp.where(nf > 0, cum[jnp.maximum(nf - 1, 0)], 0)
+            # sequential boundaries with RELATIVE budgets (an absolute
+            # target schedule breaks after a forced single-hub slice) and
+            # a forced >=1-vertex advance so an over-budget hub cannot
+            # stall the plan
+            def body(i, bounds):
+                b = bounds[i]
+                base = jnp.where(b > 0, cum[jnp.maximum(b - 1, 0)], 0)
+                nxt = jnp.searchsorted(
+                    cum, base + budget, side="right").astype(jnp.int32)
+                nxt = jnp.minimum(jnp.maximum(nxt, b + 1), nf)
+                return bounds.at[i + 1].set(nxt)
+
+            bounds = jax.lax.fori_loop(
+                0, k_max, body,
+                jnp.zeros((k_max + 1,), jnp.int32).at[0].set(
+                    jnp.minimum(fb0, nf)))
+            widths = jnp.diff(bounds)
+            plan = jnp.concatenate(
+                [jnp.stack([nf, m8, widths.max()]), bounds])
+            return frontier, plan
+        return wrapplan
+    return jit_once(f"frontier_wrapplan_{kind}", build)
+
+
+def _push_slice(kind: str):
+    """One SLICE of a frontier-push round: expand frontier[fb:fb+fcnt]'s
+    chunks and relax min(value) into neighbors. The round's changed set
+    is derived afterwards by the wrap/plan diff against ``val_old``, so
+    slices carry no stats and dispatch back-to-back with no syncs."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("f_cap", "p_cap", "n_"),
                            donate_argnums=(0,))
-        def step(val, changed, w0, dstT, colowner, wparams, W: int,
-                 n_: int):
-            w0 = jnp.minimum(w0, colowner.shape[0] - W)
-            owner = jax.lax.dynamic_slice(colowner, (w0,), (W,))
-            nbr = jax.lax.dynamic_slice(dstT, (0, w0), (8, W))
-            active = changed[owner]
-            src_val = val[owner]
+        def push(val, frontier, fb, fcnt, dstT, colstart, degc, wparams,
+                 f_cap: int, p_cap: int, n_: int):
+            fb = jnp.minimum(fb, frontier.shape[0] - f_cap)
+            fvert = jax.lax.dynamic_slice(frontier, (fb,), (f_cap,))
+            valid = jnp.arange(f_cap) < fcnt
+            v = jnp.minimum(fvert, n_)
+            cols, _, owner = enumerate_chunk_pairs(
+                valid, degc[v], colstart[v], p_cap, dstT.shape[1] - 1,
+                with_owner=True)
+            src_val = val[v][owner]                   # [p_cap]
+            nbr = jnp.take(dstT, cols, axis=1)        # [8, p_cap], pad n+1
             if kind == "sssp":
                 lane = jnp.arange(8, dtype=jnp.int32)[:, None]
-                slot = (jnp.arange(W, dtype=jnp.int32) + w0)[None, :] * 8 \
-                    + lane
+                slot = cols[None, :] * 8 + lane
                 w = _hash_weight_expr(slot, wparams[0], wparams[1])
                 msg = src_val[None, :] + w
             else:
                 msg = jnp.broadcast_to(src_val[None, :], nbr.shape)
-            big = jnp.asarray(FINF, val.dtype) if kind == "sssp" \
-                else jnp.asarray(IINF, val.dtype)
-            msg = jnp.where(active[None, :], msg, big)
             return val.at[nbr].min(msg, mode="drop")
-        return step
-    return jit_once(f"frontier_dense_{kind}", build)
+        return push
+    return jit_once(f"frontier_push_{kind}", build)
 
 
-def _dense_wrap(kind: str):
-    """After a dense round's windows: the new changed mask + stats
-    (frontier lists are built lazily when dropping back to the
-    enumeration path)."""
-    def build():
-        import jax
-        import jax.numpy as jnp
-
-        @functools.partial(jax.jit, static_argnames=("n_",))
-        def wrap(val, val_old, degc, n_: int):
-            changed = val[:n_] < val_old[:n_]
-            nf = changed.sum().astype(jnp.int32)
-            m8 = jnp.where(changed, degc[:n_], 0).sum(dtype=jnp.int32)
-            cmask = jnp.concatenate(
-                [changed, jnp.zeros((1,), bool)])
-            return cmask, jnp.stack([nf, m8])
-        return wrap
-    return jit_once(f"frontier_dense_wrap_{kind}", build)
+def _max_degc(g) -> int:
+    got = g.get("_max_degc")
+    if got is None:
+        got = int(np.asarray(g["degc"].max()))
+        g["_max_degc"] = got
+    return got
 
 
-def _frontier_list():
-    def build():
-        import jax
-        import jax.numpy as jnp
-
-        @functools.partial(jax.jit, static_argnames=("n_", "cap"))
-        def fl(cmask, n_: int, cap: int):
-            ids = jnp.nonzero(cmask[:n_], size=n_, fill_value=n_)[0] \
-                .astype(jnp.int32)
-            if cap > n_:
-                ids = jnp.concatenate(
-                    [ids, jnp.full((cap - n_,), n_, jnp.int32)])
-            return ids
-        return fl
-    return jit_once("frontier_list", build)
-
-
-def _mask_from_list():
-    def build():
-        import jax
-        import jax.numpy as jnp
-
-        @functools.partial(jax.jit, static_argnames=("n_",))
-        def mk(frontier, f_count, n_: int):
-            valid = jnp.arange(frontier.shape[0]) < f_count
-            tgt = jnp.where(valid, jnp.minimum(frontier, n_), n_ + 1)
-            return jnp.zeros((n_ + 1,), bool).at[tgt].set(
-                True, mode="drop")
-        return mk
-    return jit_once("frontier_mask_from_list", build)
-
-
-def _push_step(kind: str):
-    """One frontier-push round: expand the frontier's chunks, relax
-    min(value) into neighbors, return the new frontier (= improved
-    vertices) + stats. kind: 'sssp' (float dist + hashed weights) or
-    'wcc' (int label copy)."""
-    return jit_once(f"frontier_push_{kind}", lambda: _build_push(kind))
-
-
-def _build_push(kind: str):
-    import jax
-    import jax.numpy as jnp
-
-    @functools.partial(jax.jit,
-                       static_argnames=("f_cap", "p_cap", "n_"),
-                       donate_argnums=(0,))
-    def push(val, frontier, f_count, dstT, colstart, degc, wparams,
-             f_cap: int, p_cap: int, n_: int):
-        valid = jnp.arange(f_cap) < f_count
-        v = jnp.minimum(frontier, n_)
-        cols, _, owner = enumerate_chunk_pairs(
-            valid, degc[v], colstart[v], p_cap, dstT.shape[1] - 1,
-            with_owner=True)
-        src_val = val[v][owner]                       # [p_cap]
-        nbr = jnp.take(dstT, cols, axis=1)            # [8, p_cap], pad n+1
-        old = val
-        if kind == "sssp":
-            lane = jnp.arange(8, dtype=jnp.int32)[:, None]
-            slot = cols[None, :] * 8 + lane
-            w = _hash_weight_expr(slot, wparams[0], wparams[1])
-            msg = src_val[None, :] + w
-        else:
-            msg = jnp.broadcast_to(src_val[None, :], nbr.shape)
-        val = old.at[nbr].min(msg, mode="drop")
-        changed = val[:n_] < old[:n_]
-        nf = changed.sum().astype(jnp.int32)
-        cap = _next_pow2(max(n_, 2))
-        next_frontier = jnp.nonzero(
-            changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
-        if cap > n_:
-            next_frontier = jnp.concatenate(
-                [next_frontier,
-                 jnp.full((cap - n_,), n_, jnp.int32)])
-        m8_next = jnp.where(changed, degc[:n_], 0).sum(dtype=jnp.int32)
-        return val, next_frontier, jnp.stack([nf, m8_next])
-
-    return push
-
-
-def _frontier_run(snap_or_graph, val0, kind: str, wparams,
+def _frontier_run(snap_or_graph, val, val_old, kind: str, wparams,
                   max_rounds: int):
+    """Round loop: one wrap/plan readback per round, then budget-sliced
+    push dispatches (work tracks the actual relaxation mass). Relaxations
+    from earlier slices are visible to later ones in the same round —
+    min-relax only converges faster for it."""
     import jax.numpy as jnp
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
     n = g["n"]
     dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
-    total_chunks = g["q_total"] - 1
     cap_n = _next_pow2(max(n, 2))
-    push = _push_step(kind)
-    dense = _dense_step(kind)
-    dwrap = _dense_wrap(kind)
-    flist = _frontier_list()
-    val, frontier, f_count, m8_f, cmask = val0
+    push = _push_slice(kind)
+    wrapplan = _wrap_plan(kind)
+    budget = SLICE_BUDGET_CHUNKS
+    max_dc = _max_degc(g)
+    p_full = _next_pow2(max(budget + max_dc, 2))
 
     wp = jnp.asarray(np.asarray(wparams, np.float32))
-    W = min(DENSE_WINDOW, _next_pow2(max(total_chunks, 2)))
     rounds = 0
-    while f_count > 0 and m8_f > 0 and rounds < max_rounds:
-        if m8_f > DENSE_THRESHOLD_CHUNKS and total_chunks + 1 >= W:
-            # dense window sweep: contiguous column slices, activity
-            # masked by last round's changed set, no pair enumeration
-            colowner = _colowner(g)
-            if cmask is None:    # entering dense mode from a list round
-                cmask = _mask_from_list()(frontier, jnp.int32(f_count),
-                                          n_=n)
-            val_old = val + 0 if kind == "wcc" else val + 0.0
-            for w0 in range(0, total_chunks + 1, W):
-                val = dense(val, cmask, jnp.int32(w0), dstT, colowner,
-                            wp, W=W, n_=n)
-            cmask, st = dwrap(val, val_old, degc, n_=n)
-            f_count, m8_f = (int(x) for x in np.asarray(st))
-            frontier = None
-        else:
-            if frontier is None:     # dropping out of dense mode
-                frontier = flist(cmask, n_=n, cap=cap_n)
-            f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
-            p_cap = min(_next_pow2(max(m8_f, 2)),
-                        _next_pow2(max(total_chunks + n, 2)))
-            val, frontier, st = push(val, frontier[:f_cap],
-                                     jnp.int32(f_count), dstT, colstart,
-                                     degc, wp, f_cap=f_cap, p_cap=p_cap,
-                                     n_=n)
-            f_count, m8_f = (int(x) for x in np.asarray(st))
-            cmask = None
+    while rounds < max_rounds:
+        fb0 = 0
+        done_round = False
+        round_start = None
+        while not done_round:
+            # continuations (fb0 > 0, rare: only when a round needs more
+            # than SLICE_K_MAX slices) re-plan from the FROZEN round-start
+            # diff so the frontier indices don't shift mid-round
+            frontier, plan = wrapplan(
+                round_start if round_start is not None else val,
+                val_old, degc, jnp.int32(fb0), n_=n, cap=cap_n,
+                k_max=SLICE_K_MAX, budget=budget)
+            plan_h = np.asarray(plan)          # ONE sync per plan
+            nf, m8, wmax = (int(x) for x in plan_h[:3])
+            bounds = plan_h[3:]
+            if nf == 0 or m8 == 0:
+                return val[:n], rounds
+            if round_start is None:
+                # a REAL copy: the first push donates val's buffer
+                round_start = jnp.copy(val)
+            f_cap = min(_next_pow2(max(wmax, 2)), cap_n)
+            p_cap = min(_next_pow2(max(m8 + max_dc, 2)), p_full)
+            for i in range(SLICE_K_MAX):
+                fb, fe = int(bounds[i]), int(bounds[i + 1])
+                if fe <= fb:
+                    break
+                val = push(val, frontier, jnp.int32(fb),
+                           jnp.int32(fe - fb), dstT, colstart, degc, wp,
+                           f_cap=f_cap, p_cap=p_cap, n_=n)
+            if int(bounds[-1]) >= nf:
+                done_round = True
+            else:
+                fb0 = int(bounds[-1])
+        val_old = round_start
         rounds += 1
     return val[:n], rounds
 
@@ -282,11 +260,10 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
     n = g["n"]
-    cap_n = _next_pow2(max(n, 2))
     val = jnp.full((n + 1,), FINF, jnp.float32).at[source_dense].set(0.0)
-    frontier = jnp.full((cap_n,), n, jnp.int32).at[0].set(source_dense)
-    m8 = int(np.asarray(g["degc"][source_dense]))
-    out, rounds = _frontier_run(g, (val, frontier, 1, m8, None), "sssp",
+    # synthetic previous state: only the source reads as "improved"
+    val_old = jnp.full((n + 1,), FINF, jnp.float32)
+    out, rounds = _frontier_run(g, val, val_old, "sssp",
                                 (min_w, w_range), max_rounds)
     if not return_device:
         out = np.asarray(out)
@@ -379,19 +356,13 @@ def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
     n = g["n"]
-    cap_n = _next_pow2(max(n, 2))
-    # labels live in [0, n); the sink slot n stays at IINF
+    # labels live in [0, n); the sink slot n stays at IINF. The synthetic
+    # previous state reads every vertex as "improved" (round 1 = all)
     val = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
                            jnp.full((1,), IINF, jnp.int32)])
-    frontier = jnp.concatenate(
-        [jnp.arange(n, dtype=jnp.int32),
-         jnp.full((cap_n - n,), n, jnp.int32)]) if cap_n > n \
-        else jnp.arange(cap_n, dtype=jnp.int32)
-    cmask = jnp.concatenate([jnp.ones((n,), bool),
-                             jnp.zeros((1,), bool)])
-    total_chunks = int(g["q_total"]) - 1
-    out, rounds = _frontier_run(g, (val, frontier, n, total_chunks, cmask),
-                                "wcc", (0.0, 0.0), max_rounds)
+    val_old = val + 1
+    out, rounds = _frontier_run(g, val, val_old, "wcc", (0.0, 0.0),
+                                max_rounds)
     if not return_device:
         out = np.asarray(out)
     return out, rounds
