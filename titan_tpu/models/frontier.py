@@ -162,9 +162,14 @@ def _push_slice(kind: str):
                            donate_argnums=(0,))
         def push(val, frontier, fb, fcnt, dstT, colstart, degc, wparams,
                  f_cap: int, p_cap: int, n_: int):
-            fb = jnp.minimum(fb, frontier.shape[0] - f_cap)
-            fvert = jax.lax.dynamic_slice(frontier, (fb,), (f_cap,))
-            valid = jnp.arange(f_cap) < fcnt
+            # the slice start is clamped so dynamic_slice fits, so the
+            # validity window must be expressed in GLOBAL frontier
+            # indices — masking arange(f_cap) < fcnt after a clamp would
+            # re-process earlier vertices and silently skip the tail
+            fbc = jnp.minimum(fb, frontier.shape[0] - f_cap)
+            fvert = jax.lax.dynamic_slice(frontier, (fbc,), (f_cap,))
+            idx = jnp.arange(f_cap) + fbc
+            valid = (idx >= fb) & (idx < fb + fcnt)
             v = jnp.minimum(fvert, n_)
             cols, _, owner = enumerate_chunk_pairs(
                 valid, degc[v], colstart[v], p_cap, dstT.shape[1] - 1,
