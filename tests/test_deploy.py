@@ -1,0 +1,31 @@
+"""Deployment assembly: start/stop/status over pidfiles (titan.sh role)."""
+
+import os
+import textwrap
+import time
+
+from titan_tpu import deploy
+
+
+def test_deploy_lifecycle(tmp_path):
+    (tmp_path / "dep.yaml").write_text(textwrap.dedent(f"""\
+        services:
+          - kind: storage-node
+            name: store-a
+            data-dir: {tmp_path}/store-a
+            port: 18233
+          - kind: scan-worker
+            name: worker-a
+            port: 0
+        """))
+    path = str(tmp_path / "dep.yaml")
+    assert deploy.start(path) == 2
+    time.sleep(1.0)
+    st = deploy.status(path)
+    assert st["store-a"] and st["worker-a"]
+    # idempotent start
+    assert deploy.start(path) == 0
+    assert deploy.stop(path) == 2
+    st = deploy.status(path)
+    assert st["store-a"] is None and st["worker-a"] is None
+    assert os.path.exists(str(tmp_path / ".pids" / "store-a.log"))
