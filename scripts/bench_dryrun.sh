@@ -1,0 +1,38 @@
+#!/bin/sh
+# Bench dry-run under a clock — run this after ANY kernel change so churn
+# that breaks the bench budget is caught BEFORE the driver runs it.
+#
+#   scripts/bench_dryrun.sh           # full accelerator bench, 25-min cap
+#   scripts/bench_dryrun.sh 23        # smaller headline scale
+#   JAX_PLATFORMS=cpu scripts/bench_dryrun.sh 14   # CPU smoke test
+#
+# Pass criteria: exit 0 AND the last stdout line is parseable JSON with a
+# non-"bench_incomplete" metric. A timeout (rc=124) still leaves the
+# per-stage cumulative lines, which is the point of the restructure.
+set -u
+cd "$(dirname "$0")/.."
+CAP="${BENCH_DRYRUN_CAP_S:-1500}"
+OUT="$(mktemp)"
+timeout "$CAP" python bench.py "$@" >"$OUT" 2>/dev/null
+RC=$?
+LAST="$(tail -n 1 "$OUT")"
+echo "--- all stage lines ---"
+cat "$OUT"
+echo "--- verdict ---"
+python - "$RC" <<EOF
+import json, sys
+rc = int(sys.argv[1])
+last = """$(tail -n 1 "$OUT" | sed 's/\\\\/\\\\\\\\/g')"""
+try:
+    j = json.loads(last)
+except Exception as e:
+    print(f"FAIL: last line not JSON ({e}); rc={rc}")
+    sys.exit(1)
+ok = j.get("metric") not in (None, "bench_incomplete")
+skipped = [s["stage"] for s in j.get("detail", {}).get("skipped", [])]
+print(f"rc={rc} metric={j.get('metric')} value={j.get('value')} "
+      f"skipped={skipped}")
+print("PASS" if ok and rc == 0 else
+      ("PARTIAL: timeout but metrics captured" if ok else "FAIL"))
+sys.exit(0 if ok else 1)
+EOF
