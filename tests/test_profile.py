@@ -90,8 +90,9 @@ def test_graph_query_profiled_indexed():
 def test_traversal_profile_steps(graph):
     m = graph.traversal().V().out("knows").out("knows").count().profile()
     step_names = [s.name for s in m.steps]
-    assert step_names[-1] == "count"
-    assert step_names.count("vstep") == 2
+    # the final vstep fuses with count into one adjacency-count stage
+    assert step_names[-1] == "vstep+count"
+    assert step_names.count("vstep") == 1
     # 6 vertices -> 4 two-hop results -> count folds to 1 traverser
     assert m.steps[-1].count == 1
     assert m.total_ns > 0

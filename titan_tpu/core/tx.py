@@ -68,6 +68,14 @@ class GraphTransaction:
         # are merged on top by _iter_relations, so no invalidation needed)
         self._slice_cache: dict[bytes, list] = {}   # key -> [(SliceQuery, entries)]
         self._slice_cache_size = 0
+        # parsed-adjacency cache: (vid, direction, type_ids) -> [Edge] for
+        # the STORED part of the adjacency (deltas are merged per read).
+        # The reference's tx vertex cache holds parsed relations, not raw
+        # bytes (StandardTitanTx.java:83-1414 vertex cache + CacheVertex),
+        # so repeated traversals over the same vertices skip the column
+        # decode entirely; this is the analog for the batched DSL path.
+        self._adj_cache: dict[tuple, list] = {}
+        self._adj_cache_size = 0
         from titan_tpu.config import defaults as _d
         self._slice_cache_cap = graph.config.get(_d.TX_CACHE_SIZE)
         self._fast_property = graph.config.get(_d.FAST_PROPERTY)
@@ -570,7 +578,21 @@ class GraphTransaction:
             if not type_ids:
                 return {vid: [] for vid in vids}
         out: dict[int, list] = {vid: [] for vid in vids}
-        stored_vids = [v for v in vids if v not in self._new_vertices]
+        ckey = (direction, tuple(sorted(type_ids)) if type_ids else None)
+        stored_vids = []
+        seen_vids = set()
+        for v in vids:
+            if v in self._new_vertices or v in seen_vids:
+                continue
+            seen_vids.add(v)
+            hit = self._adj_cache.get((v, *ckey))
+            if hit is not None:
+                # deletions made after the fill are filtered per read
+                out[v] = ([e for e in hit
+                           if e.rel.relation_id not in self._deleted]
+                          if self._deleted else list(hit))
+            else:
+                stored_vids.append(v)
         keys: dict[bytes, int] = {}
         for v in stored_vids:
             if self.idm.is_partitioned_vertex(v):
@@ -579,6 +601,7 @@ class GraphTransaction:
                     keys[self.idm.key_bytes(r)] = v
             else:
                 keys[self.idm.key_bytes(v)] = v
+        stored: dict[int, list] = {v: [] for v in stored_vids}
         for q in self._slices_for(direction, type_ids, RelationCategory.EDGE,
                                   False):
             if not keys:
@@ -590,12 +613,20 @@ class GraphTransaction:
                 for entry in entries:
                     rc = self.codec.parse(entry, self.schema)
                     rel = self._relation_from_cache(vid, rc)
-                    if rel.relation_id in self._deleted:
-                        continue
                     if self._matches(rel, vid, direction, type_ids,
                                      RelationCategory.EDGE, False):
-                        out[vid].append(Edge(self, rel))
-        for vid in vids:
+                        stored[vid].append(Edge(self, rel))
+        for vid in stored_vids:
+            edges = stored[vid]
+            # cap counts VERTICES, matching the reference's tx-cache-size
+            # semantics (a vertex-count bound on the tx vertex cache)
+            if self._adj_cache_size < self._slice_cache_cap:
+                self._adj_cache[(vid, *ckey)] = edges
+                self._adj_cache_size += 1
+            out[vid] = ([e for e in edges
+                         if e.rel.relation_id not in self._deleted]
+                        if self._deleted else list(edges))
+        for vid in dict.fromkeys(vids):     # dedup: out[vid] is shared
             for rel in self._added_by_vertex.get(vid, ()):
                 if self._matches(rel, vid, direction, type_ids,
                                  RelationCategory.EDGE, False):
