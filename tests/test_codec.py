@@ -320,3 +320,32 @@ def test_ndarray_attribute_roundtrip():
         back = S.read_value(ReadBuffer(out.getvalue()))
         assert back.dtype == a.dtype and back.shape == a.shape
         assert np.array_equal(back, a)
+
+
+def test_enum_deserialization_never_imports():
+    """Stored bytes must not trigger module imports (module-level code
+    execution); only already-imported modules resolve."""
+    import enum
+    import sys
+
+    from titan_tpu.codec.attributes import Serializer
+    s = Serializer()
+    from titan_tpu.core.defs import Cardinality
+    data = s.value_bytes(Cardinality.SET)
+    assert s.value_from_bytes(data) is Cardinality.SET   # first-party: ok
+    # forge a member of a never-imported stdlib module (imports on load!)
+    victim = "antigravity"
+    assert victim not in sys.modules
+
+    class _Fake(enum.Enum):
+        X = 1
+    _Fake.__module__ = victim
+    _Fake.__qualname__ = "X"
+    try:
+        data2 = s.value_bytes(_Fake.X)
+    except TypeError:
+        data2 = None                # writer refused: equally safe
+    if data2 is not None:
+        with pytest.raises(TypeError, match="not.*imported|not importable"):
+            s.value_from_bytes(data2)
+        assert victim not in sys.modules

@@ -139,3 +139,28 @@ def test_bad_job_spec_fails_fast_as_permanent(cluster):
         [f"127.0.0.1:{w.port}" for w in workers], cfg)
     with pytest.raises(PermanentBackendError):
         runner.run(ScanJobSpec("titan_tpu.no_such_module:nope"))
+
+
+def test_worker_rejects_unlisted_factory():
+    from titan_tpu.errors import PermanentBackendError
+    from titan_tpu.utils.httpnode import json_call
+    w = ScanWorkerServer().start()
+    try:
+        with pytest.raises(PermanentBackendError, match="allowlist"):
+            json_call(w.url, "/scan", {
+                "factory": "os:system", "kwargs": {},
+                "graph_config": {}, "key_start": "", "key_end": ""})
+    finally:
+        w.stop()
+
+
+def test_worker_bearer_token_gate():
+    from titan_tpu.errors import PermanentBackendError
+    from titan_tpu.utils.httpnode import json_call
+    w = ScanWorkerServer(auth_token="s3cret").start()
+    try:
+        with pytest.raises(PermanentBackendError, match="bearer"):
+            json_call(w.url, "/ping", {})
+        assert json_call(w.url, "/ping", {}, token="s3cret") == {"ok": True}
+    finally:
+        w.stop()

@@ -351,7 +351,21 @@ class Serializer:
         def _r_enum(b):
             path, name = _r_str(b), _r_str(b)
             mod_name, _, qual = path.partition(":")
-            obj = _importlib.import_module(mod_name)
+            # deserialization must never IMPORT from stored bytes (module
+            # import runs module-level code — crafted cells could execute
+            # any module on sys.path). Resolve only from modules the
+            # application already imported, or from titan_tpu's own
+            # packages (safe: first-party, import is idempotent).
+            import sys as _sys
+            obj = _sys.modules.get(mod_name)
+            if obj is None:
+                if mod_name == "titan_tpu" or \
+                        mod_name.startswith("titan_tpu."):
+                    obj = _importlib.import_module(mod_name)
+                else:
+                    raise TypeError(
+                        f"stored enum module {mod_name!r} is not "
+                        "imported; import it before reading this value")
             for part in qual.split("."):
                 obj = getattr(obj, part)
             # guard the deserialization surface: only genuine Enum

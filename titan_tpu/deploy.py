@@ -44,10 +44,13 @@ _KINDS = {
                              s.get("data-dir", "."),
                              str(s.get("port", 8304)),
                              s.get("host", "0.0.0.0")],
+    # scan workers execute shipped job factories — localhost unless the
+    # deployment explicitly opts into a wider bind (pair with
+    # TITAN_TPU_NODE_TOKEN in the service env)
     "scan-worker": lambda s: [sys.executable, "-m",
                               "titan_tpu.olap.scan_worker",
                               str(s.get("port", 8391)),
-                              s.get("host", "0.0.0.0")],
+                              s.get("host", "127.0.0.1")],
     "graph-server": lambda s: [sys.executable, "-m", "titan_tpu.server",
                                s["conf"]],
 }

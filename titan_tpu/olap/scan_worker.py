@@ -41,15 +41,42 @@ def _ub(x: str) -> bytes:
 
 class ScanWorkerServer(JsonNode):
     """One scan worker: executes shipped splits against its own graph
-    connection (opened per request from the shipped config)."""
+    connection (opened per request from the shipped config).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        super().__init__(self._dispatch, host, port, name="scan-worker")
+    The shipped ``factory`` ("module:callable") is code selection, so the
+    worker gates it twice: the JsonNode bearer token (TITAN_TPU_NODE_TOKEN
+    or ``auth_token=``) authenticates the caller, and ``factory_allow``
+    restricts resolution to registered prefixes (default: the built-in
+    ``titan_tpu.`` jobs; extend via the TITAN_TPU_SCAN_FACTORIES env var,
+    comma-separated module prefixes)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 auth_token: Optional[str] = None,
+                 factory_allow: Optional[Sequence[str]] = None):
+        super().__init__(self._dispatch, host, port, name="scan-worker",
+                         auth_token=auth_token)
+        if factory_allow is None:
+            import os
+            extra = [p.strip() for p in
+                     os.environ.get("TITAN_TPU_SCAN_FACTORIES",
+                                    "").split(",") if p.strip()]
+            factory_allow = ["titan_tpu."] + extra
+        self.factory_allow = list(factory_allow)
+
+    def _factory_allowed(self, factory: str) -> bool:
+        mod = factory.split(":", 1)[0]
+        return any(mod == p.rstrip(".") or mod.startswith(p) or
+                   (not p.endswith(".") and mod.startswith(p + "."))
+                   for p in self.factory_allow)
 
     def _dispatch(self, path: str, req: dict):
         if path == "/ping":
             return {"ok": True}
         if path == "/scan":
+            if not self._factory_allowed(str(req["factory"])):
+                raise PermanentBackendError(
+                    f"factory {req['factory']!r} not in the worker's "
+                    "allowlist (TITAN_TPU_SCAN_FACTORIES)")
             spec = ScanJobSpec(req["factory"], dict(req.get("kwargs") or {}))
             key_range = (_ub(req["key_start"]), _ub(req["key_end"]))
             counts = _run_split(dict(req["graph_config"]), spec, key_range,
@@ -169,8 +196,14 @@ def main(argv: Optional[list] = None) -> None:
     import sys
     args = list(sys.argv[1:] if argv is None else argv)
     port = int(args[0]) if args else 0
-    host = args[1] if len(args) > 1 else "0.0.0.0"
+    # localhost by default: exposing the worker beyond the host is an
+    # explicit decision and should come with a bearer token
+    host = args[1] if len(args) > 1 else "127.0.0.1"
     node = ScanWorkerServer(host, port).start()
+    if host not in ("127.0.0.1", "localhost") and node.auth_token is None:
+        print("WARNING: scan-worker bound to a non-local interface with "
+              "no TITAN_TPU_NODE_TOKEN set — any peer can submit scan "
+              "jobs", file=sys.stderr)
     print(f"scan-worker serving on {node.url}")
     try:
         threading.Event().wait()

@@ -480,23 +480,31 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
                 raise TemporaryBackendError(
                     f"storage node {self._peer_ids[p]} unreachable: {e}") \
                     from e
-            self._peers[p] = mgr
-            self._down.discard(p)
             # probe_all connects peers concurrently; an unlocked
             # read-modify-write here could lose a False from a
             # non-TTL-capable peer
             with self._features_lock:
                 self._cell_ttl = self._cell_ttl and mgr.features.cell_ttl
-            self._replay_hints(p, mgr)
+            # drain hints BEFORE publishing the peer: once it is visible,
+            # new writes land direct, and raw storage nodes apply cells by
+            # arrival order — a later replay of OLDER hinted cells would
+            # overwrite them. The emptiness check and the publish are
+            # atomic under _hints_lock (writers queue hints under the
+            # same lock), so no hint can slip between them.
+            while True:
+                with self._hints_lock:
+                    queued = self._hints.pop(p, None)
+                    if not queued:
+                        self._peers[p] = mgr
+                        self._down.discard(p)
+                        break
+                self._replay_hints(p, mgr, queued)
         return mgr
 
-    def _replay_hints(self, p: int, mgr: RemoteStoreManager) -> None:
+    def _replay_hints(self, p: int, mgr: RemoteStoreManager,
+                      queued: list) -> None:
         """Hinted handoff: deliver the mutations this peer missed while it
         was down. LWW cells make replay safe in any order/interleaving."""
-        with self._hints_lock:
-            queued = self._hints.pop(p, None)
-        if not queued:
-            return
         muts: dict[str, dict[bytes, KCVMutation]] = {}
         for store_name, key, mut in queued:
             slot = muts.setdefault(store_name, {})
@@ -605,16 +613,22 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
 
     def _wrap_mutation(self, mut: KCVMutation, ts: int) -> KCVMutation:
         adds = []
+        added_cols = set()
         now = time.time()
         for e in mut.additions:
+            added_cols.add(bytes(e.column))
             ttl = entry_ttl(e)
             wrapped = _wrap(ts, e.value, expiry=(now + ttl) if ttl else 0.0)
             adds.append(TTLEntry(e.column, wrapped, ttl) if ttl
                         else Entry(e.column, wrapped))
         # deletions become tombstone cells so stale replicas can't
-        # resurrect them during repair/merge
+        # resurrect them during repair/merge. Same-batch add+delete of one
+        # column gets IDENTICAL ts, and the raw-bytes tie-break would pick
+        # the tombstone — inverting the KCVMutation.consolidate contract
+        # (addition overrides deletion), so consolidate here instead.
         adds.extend(Entry(col, _wrap(ts, b"", tomb=True))
-                    for col in mut.deletions)
+                    for col in mut.deletions
+                    if bytes(col) not in added_cols)
         return KCVMutation(adds, [])
 
     def mutate_many(self, mutations: dict, txh) -> None:

@@ -9,6 +9,7 @@ TemporaryBackendError (retryable by the backend-op layer); anything else →
 from __future__ import annotations
 
 import json
+import os
 import threading
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -17,16 +18,28 @@ from typing import Callable, Optional
 from titan_tpu.errors import PermanentBackendError, TemporaryBackendError
 
 
+def _env_token() -> Optional[str]:
+    return os.environ.get("TITAN_TPU_NODE_TOKEN") or None
+
+
 class JsonNode:
-    """HTTP server shell around a ``dispatch(path, request_dict)`` callable."""
+    """HTTP server shell around a ``dispatch(path, request_dict)`` callable.
+
+    ``auth_token``: shared bearer token; every request must carry
+    ``Authorization: Bearer <token>`` (401 otherwise). ``None`` falls back
+    to the ``TITAN_TPU_NODE_TOKEN`` env var (set it on every node and
+    every client process and the whole mesh authenticates); ``""``
+    disables auth explicitly."""
 
     def __init__(self, dispatch: Callable[[str, dict], dict],
                  host: str = "127.0.0.1", port: int = 0,
-                 name: str = "node"):
+                 name: str = "node", auth_token: Optional[str] = None):
         self._dispatch = dispatch
         self.host = host
         self.port = port
         self._name = name
+        self.auth_token = _env_token() if auth_token is None else \
+            (auth_token or None)
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def start(self) -> "JsonNode":
@@ -45,6 +58,11 @@ class JsonNode:
                 self.wfile.write(body)
 
             def do_POST(self):
+                if node.auth_token is not None and \
+                        self.headers.get("Authorization") != \
+                        f"Bearer {node.auth_token}":
+                    self._send(401, {"error": "missing or bad bearer token"})
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     req = json.loads(self.rfile.read(length) or b"{}")
@@ -75,11 +93,16 @@ class JsonNode:
 
 
 def json_call(url: str, path: str, payload: dict,
-              timeout: float = 30.0) -> dict:
-    """Client half: POST + error-taxonomy mapping."""
+              timeout: float = 30.0, token: Optional[str] = None) -> dict:
+    """Client half: POST + error-taxonomy mapping. ``token`` defaults to
+    the ``TITAN_TPU_NODE_TOKEN`` env var (the server shell's counterpart)."""
+    headers = {"Content-Type": "application/json"}
+    token = _env_token() if token is None else (token or None)
+    if token is not None:
+        headers["Authorization"] = f"Bearer {token}"
     req = urllib.request.Request(
         url + path, data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
+        headers=headers, method="POST")
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return json.loads(r.read())
