@@ -4,9 +4,12 @@ import os
 import textwrap
 import time
 
+import pytest
+
 from titan_tpu import deploy
 
 
+@pytest.mark.slow
 def test_deploy_lifecycle(tmp_path):
     (tmp_path / "dep.yaml").write_text(textwrap.dedent(f"""\
         services:

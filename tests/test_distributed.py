@@ -58,6 +58,7 @@ def test_in_process_split_runner_matches_full_scan():
     g.close()
 
 
+@pytest.mark.slow
 def test_distributed_runner_processes(tmp_path):
     cfg = {"storage.backend": "sqlite",
            "storage.directory": str(tmp_path / "db")}
@@ -78,6 +79,7 @@ def test_distributed_runner_processes(tmp_path):
     assert m2.get(VertexCountJob.EDGES) == 60
 
 
+@pytest.mark.slow
 def test_distributed_reindex(tmp_path):
     cfg = {"storage.backend": "sqlite",
            "storage.directory": str(tmp_path / "db")}

@@ -64,6 +64,7 @@ def test_chain_graph_many_levels():
 
 
 @pytest.mark.parametrize("seed", [0, 5])
+@pytest.mark.slow
 def test_sharded_matches_single_chip(seed):
     from titan_tpu.models.bfs import frontier_bfs_sharded
     from titan_tpu.parallel.mesh import vertex_mesh

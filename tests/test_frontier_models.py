@@ -38,6 +38,7 @@ def adjacency_with_slots(snap):
 
 
 @pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.slow
 def test_hybrid_bfs_matches_reference(seed):
     rng = np.random.default_rng(seed)
     n = int(rng.integers(50, 400))
@@ -48,6 +49,7 @@ def test_hybrid_bfs_matches_reference(seed):
     assert (d_ref == np.asarray(d_hyb)).all()
 
 
+@pytest.mark.slow
 def test_hybrid_bfs_rmat_both_modes():
     src, dst = rmat_edges(11, 8, seed=4)
     n = 1 << 11

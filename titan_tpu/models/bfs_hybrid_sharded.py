@@ -50,6 +50,33 @@ BU_FUSE = 4
 LAST_EXCHANGE_CAPS: list = []
 
 
+def plan_shard_cuts(colstart: np.ndarray, n: int, num_shards: int):
+    """Edge-balanced vertex-range cuts on the chunk prefix, with the
+    int32 safety guard: per-shard arrays use LOCAL column indices, so
+    every shard's chunk span must stay < 2^31 even when the GLOBAL chunk
+    count exceeds int32 (``colstart`` is int64 host-side). Returns
+    (bounds [d_eff+1] int64, b_max, q_max). Raises NotImplementedError
+    when any shard's local span would overflow int32 — shard wider."""
+    total = int(colstart[n])
+    cuts = [0]
+    for k in range(1, num_shards):
+        cuts.append(int(np.searchsorted(colstart[:n + 1],
+                                        k * total / num_shards)))
+    cuts.append(n)
+    bounds = np.asarray(sorted(set(cuts)), np.int64)
+    d_eff = len(bounds) - 1
+    b_max = max(1, int((bounds[1:] - bounds[:-1]).max()))
+    spans = [int(colstart[bounds[d + 1]] - colstart[bounds[d]])
+             for d in range(d_eff)]
+    q_max = max(1, max(spans)) + 1       # +1 local sink col
+    if q_max >= (1 << 31):
+        raise NotImplementedError(
+            f"a shard's local chunk span ({max(spans)}) exceeds int32; "
+            f"use more shards than {num_shards} (local column indices "
+            "are int32)")
+    return bounds, b_max, q_max
+
+
 def shard_chunked_csr(snap_or_graph, num_shards: int):
     """Edge-balanced vertex-range shards of the chunked CSR, padded to
     uniform shapes: dict with ``dstT_sh`` [D, 8, Qmax] (pad n+1),
@@ -86,18 +113,9 @@ def shard_chunked_csr(snap_or_graph, num_shards: int):
                 "a to_device() result")
     colstart = np.asarray(colstart)
     dstT = np.asarray(dstT)
-    # edge-balanced cuts on the chunk prefix
-    total = int(colstart[n])
-    cuts = [0]
-    for k in range(1, num_shards):
-        cuts.append(int(np.searchsorted(colstart[:n + 1],
-                                        k * total / num_shards)))
-    cuts.append(n)
-    bounds = np.asarray(sorted(set(cuts)), np.int64)
+    bounds, b_max, q_max = plan_shard_cuts(colstart, n, num_shards)
     d_eff = len(bounds) - 1
-    b_max = max(1, int((bounds[1:] - bounds[:-1]).max()))
-    q_max = max(1, max(int(colstart[bounds[d + 1]] - colstart[bounds[d]])
-                       for d in range(d_eff))) + 1   # +1 local sink col
+    total = int(colstart[n])
     dstT_sh = np.full((num_shards, 8, q_max), n + 1, np.int32)
     colstart_sh = np.zeros((num_shards, b_max + 1), np.int32)
     degc_sh = np.zeros((num_shards, b_max), np.int32)
