@@ -62,6 +62,14 @@ class StandardGraph:
         self.serializer = Serializer()
         self.codec = EdgeCodec(self.serializer, self.idm)
 
+        # snapshot freshness: monotone commit counter + in-process change
+        # listeners (OLAP snapshots subscribe so refresh() can apply
+        # deltas without re-scanning the store; the reference instead
+        # re-scans live data every OLAP run — StandardScannerExecutor)
+        self._mutation_epoch = 0
+        self._change_listeners: dict[int, list] = {}
+        self._listener_seq = 0
+
         # WAL (reference: tx.log-tx → txlog writes in the commit path)
         self._wal = None
         if config.get(d.LOG_TX):
@@ -373,6 +381,16 @@ class StandardGraph:
                     raise
             if wal is not None:
                 wal.log_primary_success(txid)
+            # storage is durable: bump the mutation epoch and feed any
+            # subscribed snapshots their delta (see snapshot.refresh)
+            self._mutation_epoch += 1
+            if self._change_listeners:
+                from titan_tpu.core.changes import change_payload
+                payload = change_payload(self, tx,
+                                         txid if txid is not None
+                                         else self._mutation_epoch)
+                for q in self._change_listeners.values():
+                    q.append(payload)
             try:
                 btx.commit_indexes()
                 # user trigger log between index commit and the SECONDARY
@@ -399,6 +417,28 @@ class StandardGraph:
             # column for every later tx until expiry
             if locker is not None and lock_state.has_locks:
                 locker.release_locks(lock_state)
+
+    # ------------------------------------------------- change subscription
+
+    @property
+    def mutation_epoch(self) -> int:
+        """Monotone counter of committed transactions on THIS instance —
+        the snapshot staleness epoch (snapshot.epoch < graph.mutation_epoch
+        means the snapshot misses committed data)."""
+        return self._mutation_epoch
+
+    def subscribe_changes(self) -> tuple[int, list]:
+        """Register an in-process change listener; every commit appends its
+        change payload (core/changes.change_payload shape) to the returned
+        list. Used by OLAP snapshots for delta refresh."""
+        self._listener_seq += 1
+        token = self._listener_seq
+        q: list = []
+        self._change_listeners[token] = q
+        return token, q
+
+    def unsubscribe_changes(self, token: int) -> None:
+        self._change_listeners.pop(token, None)
 
     def _needs_lock(self, rel) -> bool:
         if self.backend.locker is None:

@@ -45,10 +45,207 @@ class GraphSnapshot:
     edge_values: dict = field(default_factory=dict)  # name -> [E] array
     labels: Optional[np.ndarray] = None              # [E] int32 label codes
     label_names: dict = field(default_factory=dict)  # code -> label name
+    # freshness contract (see refresh()): epoch is graph.mutation_epoch at
+    # build/refresh time; build() subscribes an in-process change listener
+    epoch: int = 0
+    _graph: object = None
+    _listener_token: int = 0
+    _listener: Optional[list] = None
+    _build_params: dict = field(default_factory=dict)
 
     @property
     def num_edges(self) -> int:
         return len(self.src)
+
+    @property
+    def stale(self) -> bool:
+        """True when commits landed on the source graph after this
+        snapshot's epoch (the reference never has this problem — its OLAP
+        scans the LIVE store every run, StandardScannerExecutor.java:85-188;
+        a build-once device snapshot needs the explicit contract)."""
+        g = self._graph
+        return g is not None and self.epoch < g.mutation_epoch
+
+    def close(self) -> None:
+        """Detach the change listener (stops delta accumulation)."""
+        g = self._graph
+        if g is not None and self._listener_token:
+            g.unsubscribe_changes(self._listener_token)
+            self._graph = None
+            self._listener = None
+
+    def refresh(self) -> dict:
+        """Apply the commits since ``epoch`` to this snapshot IN MEMORY —
+        no store re-scan. Pure edge additions take an O(delta + E) merge
+        into the dst-sorted arrays; vertex additions/removals or edge
+        removals rebuild the CSR from the patched in-memory edge list
+        (still host-array work only). Device-layout caches (_out_csr,
+        bfs_hybrid's chunked CSR) are invalidated. Returns stats.
+
+        Only commits on THIS graph instance are seen (they are the only
+        ones the in-process listener observes); cross-instance writers
+        need a rebuild — or wire the durable trigger log into
+        ``apply_changes`` via the LogProcessorFramework."""
+        g = self._graph
+        if g is None:
+            raise RuntimeError("snapshot has no source graph "
+                               "(built from_arrays or closed)")
+        if self.edge_values:
+            raise NotImplementedError(
+                "refresh() with extracted edge_values: change payloads "
+                "don't carry edge properties — rebuild the snapshot")
+        new_epoch = g.mutation_epoch
+        q = self._listener
+        pending: list = []
+        while q:                 # pop-drain: a concurrent commit's append
+            pending.append(q.pop(0))   # is never lost (worst case it is
+        #                              # applied now AND epoch stays behind
+        #                              # -> one extra no-op refresh later)
+        stats = self.apply_changes(pending, g.schema, g.idm)
+        self.epoch = new_epoch
+        return stats
+
+    def apply_changes(self, payloads: list, schema, idm) -> dict:
+        """Apply change payloads (core/changes.change_payload dicts — from
+        the in-process listener or deserialized from the user trigger
+        log) to the in-memory CSR."""
+        params = self._build_params or {}
+        label_ids = params.get("label_ids")
+        directed = params.get("directed", True)
+        add_src: list = []
+        add_dst: list = []
+        add_lab: list = []
+        removed_edges: list = []
+        new_vids: set = set()
+        dead_vids: set = set()
+        for p in payloads:
+            for vid in p.get("added_vertices", ()):
+                new_vids.add(idm.canonical_vertex_id(vid))
+            for vid in p.get("removed_vertices", ()):
+                dead_vids.add(idm.canonical_vertex_id(vid))
+            for r in p.get("added", ()):
+                if "in" not in r:
+                    continue                      # property, not an edge
+                st = schema.get_by_name(r["type"])
+                if st is None or (label_ids is not None
+                                  and st.id not in label_ids):
+                    continue
+                add_src.append(idm.canonical_vertex_id(r["out"]))
+                add_dst.append(idm.canonical_vertex_id(r["in"]))
+                add_lab.append(idm.count(st.id))
+                self.label_names.setdefault(idm.count(st.id), st.name)
+            for r in p.get("removed", ()):
+                if "in" not in r:
+                    continue
+                st = schema.get_by_name(r["type"])
+                if st is None:
+                    continue
+                removed_edges.append(
+                    (idm.canonical_vertex_id(r["out"]),
+                     idm.canonical_vertex_id(r["in"]), idm.count(st.id)))
+        new_vids -= set(self.vertex_ids.tolist())
+        stats = {"added_edges": len(add_src),
+                 "removed_edges": len(removed_edges),
+                 "added_vertices": len(new_vids),
+                 "removed_vertices": len(dead_vids)}
+        if not (add_src or removed_edges or new_vids or dead_vids):
+            return stats
+
+        self._invalidate_layout_caches()
+        need_rebuild = bool(removed_edges or new_vids or dead_vids)
+        if not need_rebuild:
+            self._merge_edges(np.asarray(add_src, np.int64),
+                              np.asarray(add_dst, np.int64),
+                              np.asarray(add_lab, np.int32), directed)
+            return stats
+
+        # general path: patch the edge list in memory, re-densify, rebuild
+        old_ids = self.vertex_ids
+        src_ids = old_ids[self.src.astype(np.int64)]
+        dst_ids = old_ids[self.dst.astype(np.int64)]
+        labs = self.labels if self.labels is not None \
+            else np.zeros(len(src_ids), np.int32)
+        keep = np.ones(len(src_ids), bool)
+        if removed_edges:
+            # drop ONE row per removed relation (parallel edges are
+            # distinct relations, each contributing one row [+reverse])
+            from collections import Counter
+            want = Counter(removed_edges)
+            for i in range(len(src_ids)):
+                key = (int(src_ids[i]), int(dst_ids[i]), int(labs[i]))
+                rkey = (int(dst_ids[i]), int(src_ids[i]), int(labs[i]))
+                if want.get(key, 0) > 0:
+                    want[key] -= 1
+                    keep[i] = False
+                elif not directed and want.get(rkey, 0) > 0:
+                    # symmetrized snapshots hold the reverse row too
+                    want[rkey] -= 1
+                    keep[i] = False
+        if dead_vids:
+            dead = np.asarray(sorted(dead_vids), np.int64)
+            keep &= ~np.isin(src_ids, dead) & ~np.isin(dst_ids, dead)
+        src_ids, dst_ids, labs = src_ids[keep], dst_ids[keep], labs[keep]
+        if add_src:
+            a_s = np.asarray(add_src, np.int64)
+            a_d = np.asarray(add_dst, np.int64)
+            a_l = np.asarray(add_lab, np.int32)
+            if not directed:
+                a_s, a_d = (np.concatenate([a_s, a_d]),
+                            np.concatenate([a_d, a_s]))
+                a_l = np.concatenate([a_l, a_l])
+            src_ids = np.concatenate([src_ids, a_s])
+            dst_ids = np.concatenate([dst_ids, a_d])
+            labs = np.concatenate([labs, a_l])
+        ids = np.asarray(sorted((set(old_ids.tolist()) | new_vids)
+                                - dead_vids), np.int64)
+        si = np.searchsorted(ids, src_ids)
+        di = np.searchsorted(ids, dst_ids)
+        rebuilt = from_arrays(len(ids), si.astype(np.int32),
+                              di.astype(np.int32), ids, None, labs,
+                              self.label_names)
+        self.n = rebuilt.n
+        self.vertex_ids = rebuilt.vertex_ids
+        self.src, self.dst = rebuilt.src, rebuilt.dst
+        self.indptr_in = rebuilt.indptr_in
+        self.out_degree = rebuilt.out_degree
+        self.labels = rebuilt.labels
+        return stats
+
+    def _merge_edges(self, src_ids, dst_ids, labs, directed) -> None:
+        """Fast path: merge NEW edges of EXISTING vertices into the
+        dst-sorted arrays (one O(E) insert, no re-sort of old rows)."""
+        if not directed:
+            src_ids, dst_ids = (np.concatenate([src_ids, dst_ids]),
+                                np.concatenate([dst_ids, src_ids]))
+            labs = np.concatenate([labs, labs])
+        si = np.searchsorted(self.vertex_ids, src_ids)
+        di = np.searchsorted(self.vertex_ids, dst_ids)
+        ok = (si < self.n) & (di < self.n)
+        ok &= (self.vertex_ids[np.minimum(si, self.n - 1)] == src_ids) \
+            & (self.vertex_ids[np.minimum(di, self.n - 1)] == dst_ids)
+        si, di, labs = (si[ok].astype(np.int32), di[ok].astype(np.int32),
+                        labs[ok])
+        order = np.argsort(di, kind="stable")
+        si, di, labs = si[order], di[order], labs[order]
+        pos = np.searchsorted(self.dst, di, side="right")
+        self.src = np.insert(self.src, pos, si)
+        self.dst = np.insert(self.dst, pos, di)
+        if self.labels is not None:
+            self.labels = np.insert(self.labels, pos, labs)
+        counts = np.diff(self.indptr_in)
+        np.add.at(counts, di.astype(np.int64), 1)
+        self.indptr_in = np.concatenate(
+            [np.zeros(1, np.int64), np.cumsum(counts, dtype=np.int64)])
+        np.add.at(self.out_degree, si, 1)
+
+    def _invalidate_layout_caches(self) -> None:
+        """Drop every derived layout / device-array cache the model
+        kernels lazily attach (they rebuild from the refreshed arrays)."""
+        for attr in ("_out_csr", "_hybrid_csr", "_frontier_shards",
+                     "_dev_frontier_sh", "_tiled_shards", "_dev_outdeg",
+                     "_dev_frontier"):
+            if hasattr(self, attr):
+                delattr(self, attr)
 
     def dense_of(self, vertex_id: int) -> int:
         i = int(np.searchsorted(self.vertex_ids, vertex_id))
@@ -293,4 +490,11 @@ def build(graph, labels: Optional[Sequence[str]] = None,
         st = schema.get_type(idm.schema_id(IDType.USER_EDGE_LABEL, code))
         if st is not None:
             label_names[code] = st.name
-    return from_arrays(n, src, dst, vertex_ids, evs, labs_arr, label_names)
+    snap = from_arrays(n, src, dst, vertex_ids, evs, labs_arr, label_names)
+    # freshness contract: stamp the epoch and subscribe for deltas so
+    # refresh() can catch this snapshot up without a store re-scan
+    snap.epoch = graph.mutation_epoch
+    snap._graph = graph
+    snap._listener_token, snap._listener = graph.subscribe_changes()
+    snap._build_params = {"label_ids": label_ids, "directed": directed}
+    return snap
