@@ -33,19 +33,21 @@ import time
 
 import numpy as np
 
-BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2400"))
 _T_START = time.time()
 
 # conservative per-stage wall-clock estimates (seconds, accelerator path,
-# warm disk cache / warm XLA cache; measured on-device this round). Used
-# only to decide whether a stage still fits in the budget.
+# warm disk cache / warm XLA cache; measured on-device 2026-07-30 on a
+# SLOW-tunnel day — H2D ran at ~10-20MB/s, so the scale-26 upload alone
+# was 430-830s; fast days are ~10-30x quicker). Used only to decide
+# whether a stage still fits in the budget.
 _EST = {
     "gods_2hop": 20,
     "ldbc": 120,
-    "bfs23": 180,
-    "bfs26": 420,
-    "pagerank": 180,
-    "ssspwcc": 300,
+    "bfs23": 250,      # 1.2GB upload + runs
+    "bfs26": 900,      # 9GB upload (430-830s slow-day) + 3 reps x ~14s
+    "ssspwcc": 600,    # measured: SSSP ~400s + WCC ~160s (25/4 rounds)
+    "pagerank": 250,   # 0.6GB upload + 12 iterations
 }
 
 
@@ -80,6 +82,33 @@ class Report:
         self.emit()
 
 
+# device-graph cache shared across stages: the H2D upload of the scale-26
+# arrays (9GB) can cost MINUTES through the axon tunnel on a bad day —
+# never upload the same graph twice
+_DEV_GRAPHS: dict = {}
+
+
+def _load_device_graph(scale: int, edge_factor: int = 16, seed: int = 2):
+    import jax
+
+    from titan_tpu.olap.tpu import graph500
+
+    key = (scale, edge_factor, seed)
+    if key in _DEV_GRAPHS:
+        return _DEV_GRAPHS[key] + (0.0, 0.0)
+    # one resident graph at a time: scale-26 alone is ~10GB of the 16GB HBM
+    _DEV_GRAPHS.clear()
+    t0 = time.time()
+    hg = graph500.load_or_build(scale, edge_factor, seed=seed, verbose=False)
+    gen_s = time.time() - t0
+    t0 = time.time()
+    g = graph500.to_device(hg)
+    jax.block_until_ready(g["dstT"])
+    upload_s = time.time() - t0
+    _DEV_GRAPHS[key] = (hg, g)
+    return hg, g, gen_s, upload_s
+
+
 def bfs_teps(scale: int, edge_factor: int = 16, seed: int = 2,
              reps: int = 3, sources: int = 1) -> dict:
     import jax
@@ -88,16 +117,15 @@ def bfs_teps(scale: int, edge_factor: int = 16, seed: int = 2,
     from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
     from titan_tpu.olap.tpu import graph500
 
-    t0 = time.time()
-    hg = graph500.load_or_build(scale, edge_factor, seed=seed, verbose=False)
-    gen_s = time.time() - t0
-
     # multi-chip: shard the edge arrays over a vertex mesh (sparse
     # found-list exchange; models/bfs_hybrid_sharded); single chip: the
-    # plain hybrid kernel on the uploaded graph
+    # plain hybrid kernel on the uploaded (stage-shared) graph
     ndev = jax.device_count()
-    t0 = time.time()
     if ndev > 1:
+        t0 = time.time()
+        hg = graph500.load_or_build(scale, edge_factor, seed=seed,
+                                    verbose=False)
+        gen_s = time.time() - t0
         from titan_tpu.models.bfs_hybrid_sharded import \
             frontier_bfs_hybrid_sharded
         from titan_tpu.parallel.mesh import vertex_mesh
@@ -108,12 +136,11 @@ def bfs_teps(scale: int, edge_factor: int = 16, seed: int = 2,
                                                return_device=True)
         upload_s = 0.0          # sharded path uploads inside the first run
     else:
-        g = graph500.to_device(hg)
-        jax.block_until_ready(g["dstT"])
+        hg, g, gen_s, upload_s = _load_device_graph(scale, edge_factor,
+                                                    seed)
 
         def run_bfs(source):
             return frontier_bfs_hybrid(g, source, return_device=True)
-        upload_s = time.time() - t0
 
     deg = np.asarray(hg["deg"])
     # Graph500 rule: sample DISTINCT sources with degree > 0
@@ -186,28 +213,27 @@ def sssp_wcc(rep: Report, scale: int) -> None:
     import jax
 
     from titan_tpu.models.frontier import frontier_sssp, frontier_wcc
-    from titan_tpu.olap.tpu import graph500
 
-    hg = graph500.load_or_build(scale, 16, seed=2, verbose=False)
-    g = graph500.to_device(hg)
+    hg, g, _, _ = _load_device_graph(scale)
     deg = np.asarray(hg["deg"])
     source = int(np.flatnonzero(deg > 0)[0])
 
-    d, _ = frontier_sssp(g, source, return_device=True)   # warm-up
-    jax.block_until_ready(d)
+    # NO warm-up pass: at bench scale one SSSP run costs ~400s (measured
+    # 2026-07-30: 25 sliced rounds) — executables come from the
+    # persistent XLA cache, so a single timed run is representative
     t0 = time.time()
     d, rounds = frontier_sssp(g, source, return_device=True)
     jax.block_until_ready(d)
+    _ = float(np.asarray(d[0]))      # force completion through the tunnel
     rep.detail["sssp_seconds"] = round(time.time() - t0, 3)
     rep.detail["sssp_rounds"] = rounds
     rep.detail["sssp_scale"] = scale
     rep.emit()
 
-    lab, _ = frontier_wcc(g, return_device=True)          # warm-up
-    jax.block_until_ready(lab)
     t0 = time.time()
     lab, rounds = frontier_wcc(g, return_device=True)
     jax.block_until_ready(lab)
+    _ = float(np.asarray(lab[0]))
     rep.detail["wcc_seconds"] = round(time.time() - t0, 3)
     rep.detail["wcc_rounds"] = rounds
     rep.emit()
@@ -221,16 +247,14 @@ def pagerank_stage(rep: Report, lj_scale: int) -> None:
     import jax
 
     from titan_tpu.models.frontier import pagerank_dense
-    from titan_tpu.olap.tpu import graph500
 
-    hg = graph500.load_or_build(lj_scale, 16, seed=2, verbose=False)
-    g = graph500.to_device(hg)
+    hg, g, _, _ = _load_device_graph(lj_scale)
     r, _ = pagerank_dense(g, iterations=2, return_device=True)  # warm
-    jax.block_until_ready(r)
-    t0 = time.time()
+    _ = float(np.asarray(r[0]))  # block_until_ready is dispatch-only
+    t0 = time.time()             # through the axon tunnel — force D2H
     iters = 10
     r, _ = pagerank_dense(g, iterations=iters, return_device=True)
-    jax.block_until_ready(r)
+    _ = float(np.asarray(r[0]))
     sec = (time.time() - t0) / iters
     rep.detail["pagerank_lj_sec_per_iter"] = round(sec, 3)
     rep.detail["pagerank_lj_edges"] = hg["e_dedup"]
@@ -354,14 +378,16 @@ def main() -> None:
     rep.detail["platform"] = platform
     rep.detail["n_devices"] = jax.device_count()
 
+    # ssspwcc runs right after the headline BFS so the ~10GB scale-26
+    # device graph is uploaded ONCE and shared; pagerank evicts it
     stages = [
         ("gods_2hop", lambda: gods_2hop(rep)),
         ("ldbc", (lambda: ldbc_is3_4hop(rep)) if on_accel else
          (lambda: ldbc_is3_4hop(rep, n_persons=1000, avg_degree=10))),
         ("bfs23", lambda: _bfs_stage(rep, warm_scale, "warm")),
         ("bfs26", lambda: _bfs_stage(rep, headline_scale, "headline")),
-        ("pagerank", lambda: pagerank_stage(rep, lj_scale)),
         ("ssspwcc", lambda: sssp_wcc(rep, headline_scale)),
+        ("pagerank", lambda: pagerank_stage(rep, lj_scale)),
     ]
     if warm_scale == headline_scale:      # CPU/CI path: one BFS scale
         stages = [s for s in stages if s[0] != "bfs23"]

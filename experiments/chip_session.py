@@ -1,0 +1,78 @@
+"""Persistent chip session: holds the scale-26 device graph and execs
+numbered command files, so probes iterate without paying the ~14-min
+upload per experiment on slow-tunnel days.
+
+    python -u experiments/chip_session.py 26 &
+    # then drop python snippets into /tmp/chip_cmd/NNN.py; stdout+result
+    # appended to /tmp/chip_session.log; "QUIT" file exits.
+
+Namespace exposed to snippets: np, jax, jnp, hg (host graph dict),
+g (device graph dict), H (bfs_hybrid module), graph500, time.
+"""
+import os
+import sys
+import time
+import traceback
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CMD_DIR = "/tmp/chip_cmd"
+LOG = "/tmp/chip_session.log"
+
+
+def log(msg):
+    with open(LOG, "a") as f:
+        f.write(msg + "\n")
+    print(msg, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import titan_tpu.models.bfs_hybrid as H
+    from titan_tpu.olap.tpu import graph500
+
+    cache = __file__.rsplit("/", 2)[0] + "/.bench_cache/xla"
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass
+
+    os.makedirs(CMD_DIR, exist_ok=True)
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 26
+    t0 = time.time()
+    hg = graph500.load_or_build(scale, 16, seed=2, verbose=False)
+    g = graph500.to_device(hg)
+    jax.block_until_ready(g["dstT"])
+    _ = np.asarray(g["colstart"][0])
+    log(f"READY scale={scale} upload+load {time.time()-t0:.1f}s")
+
+    ns = {"np": np, "jax": jax, "jnp": jnp, "hg": hg, "g": g, "H": H,
+          "graph500": graph500, "time": time, "log": log}
+    done = set()
+    while True:
+        if os.path.exists(os.path.join(CMD_DIR, "QUIT")):
+            log("QUIT")
+            return
+        for name in sorted(os.listdir(CMD_DIR)):
+            if not name.endswith(".py") or name in done:
+                continue
+            done.add(name)
+            log(f"--- exec {name} ---")
+            try:
+                src = open(os.path.join(CMD_DIR, name)).read()
+                t0 = time.time()
+                exec(src, ns)
+                log(f"--- {name} ok in {time.time()-t0:.1f}s ---")
+            except Exception:
+                log(traceback.format_exc())
+        time.sleep(1)
+
+
+main()

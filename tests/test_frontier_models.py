@@ -235,3 +235,23 @@ def test_sliced_rounds_cap_boundary_regime(kind, monkeypatch):
 def sym_snap_from_arrays(src, dst, n):
     return snap_mod.from_arrays(n, np.concatenate([src, dst]),
                                 np.concatenate([dst, src]))
+
+
+def test_hybrid_max_levels_truncates():
+    """Review regression: the fused endgame must honor max_levels."""
+    import numpy as np
+
+    from titan_tpu.models.bfs import INF
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+
+    k = 8
+    src = np.arange(k - 1, dtype=np.int64)
+    dst = src + 1
+    snap = snap_mod.from_arrays(
+        k, np.concatenate([src, dst]).astype(np.int32),
+        np.concatenate([dst, src]).astype(np.int32))
+    dist, levels = frontier_bfs_hybrid(snap, 0, max_levels=2)
+    assert levels <= 2
+    assert dist[1] == 1 and dist[2] == 2
+    assert (dist[3:] >= INF).all()

@@ -139,3 +139,56 @@ def test_unsubscribed_snapshot_stops_accumulating(graph):
     assert not graph._change_listeners
     with pytest.raises(RuntimeError):
         snap.refresh()
+
+
+def test_refresh_added_edge_to_vertex_removed_later(graph):
+    """Review regression: commit A adds an edge to v; commit B removes v;
+    refresh must drop the edge (like a rebuild), not rewire it."""
+    snap = snap_mod.build(graph)
+    tx = graph.new_transaction()
+    vs = sorted(tx.vertices(), key=lambda v: v.value("name"))
+    vs[5].add_edge("link", vs[2])
+    tx.commit()
+    tx = graph.new_transaction()
+    vs2 = sorted(tx.vertices(), key=lambda v: v.value("name"))
+    vs2[2].remove()
+    tx.commit()
+    snap.refresh()
+    fresh = snap_mod.build(graph)
+    assert (snap.vertex_ids == fresh.vertex_ids).all()
+    assert _edge_id_pairs(snap) == _edge_id_pairs(fresh)
+
+
+def test_change_queue_overflow_forces_rebuild(graph):
+    from titan_tpu.core import changes as ch
+    snap = snap_mod.build(graph)
+    snap._listener.overflowed = True      # simulate >10k-commit backlog
+    tx = graph.new_transaction()
+    vs = list(tx.vertices())
+    vs[0].add_edge("link", vs[1])
+    tx.commit()
+    with pytest.raises(RuntimeError, match="overflow"):
+        snap.refresh()
+
+
+def test_refresh_gap_detection(graph):
+    """Payload-epoch continuity: a missing delta (e.g. a commit during
+    build()'s scan) must fail loud, not corrupt silently."""
+    snap = snap_mod.build(graph)
+    tx = graph.new_transaction()
+    vs = list(tx.vertices())
+    vs[0].add_edge("link", vs[1])
+    tx.commit()
+    snap._listener.pop(0)                 # simulate a missed commit
+    with pytest.raises(RuntimeError, match="gap"):
+        snap.refresh()
+
+
+def test_dropped_snapshot_unregisters_listener(graph):
+    import gc
+    n0 = len(graph._change_listeners)
+    snap = snap_mod.build(graph)
+    assert len(graph._change_listeners) == n0 + 1
+    del snap
+    gc.collect()
+    assert len(graph._change_listeners) == n0

@@ -25,6 +25,32 @@ log_ = logging.getLogger(__name__)
 
 USER_LOG_PREFIX = "ulog_"
 
+# in-process listener backlog bound: past this, the queue declares
+# overflow and the subscriber must full-rebuild instead of delta-refresh
+CHANGE_QUEUE_CAP = 10_000
+
+
+class ChangeQueue(list):
+    """Bounded change-payload backlog for in-process subscribers (list
+    subclass so the graph's registry can hold it by WEAK reference —
+    builtin lists aren't weak-referenceable). ``overflowed`` means
+    payloads were dropped: delta refresh is no longer sound."""
+
+    __slots__ = ("__weakref__", "overflowed")
+
+    def __init__(self):
+        super().__init__()
+        self.overflowed = False
+
+    def push(self, payload: dict) -> None:
+        if self.overflowed:
+            return
+        if len(self) >= CHANGE_QUEUE_CAP:
+            self.overflowed = True
+            self.clear()
+            return
+        self.append(payload)
+
 
 class ChangeState:
     """One committed transaction's change set, as delivered to processors

@@ -65,9 +65,13 @@ class StandardGraph:
         # snapshot freshness: monotone commit counter + in-process change
         # listeners (OLAP snapshots subscribe so refresh() can apply
         # deltas without re-scanning the store; the reference instead
-        # re-scans live data every OLAP run — StandardScannerExecutor)
+        # re-scans live data every OLAP run — StandardScannerExecutor).
+        # Held WEAKLY: a snapshot dropped without close() auto-unregisters
+        # instead of accumulating payloads forever.
+        import weakref
         self._mutation_epoch = 0
-        self._change_listeners: dict[int, list] = {}
+        self._change_listeners: "weakref.WeakValueDictionary" = \
+            weakref.WeakValueDictionary()
         self._listener_seq = 0
 
         # WAL (reference: tx.log-tx → txlog writes in the commit path)
@@ -381,16 +385,23 @@ class StandardGraph:
                     raise
             if wal is not None:
                 wal.log_primary_success(txid)
-            # storage is durable: bump the mutation epoch and feed any
-            # subscribed snapshots their delta (see snapshot.refresh)
-            self._mutation_epoch += 1
-            if self._change_listeners:
-                from titan_tpu.core.changes import change_payload
-                payload = change_payload(self, tx,
-                                         txid if txid is not None
-                                         else self._mutation_epoch)
-                for q in self._change_listeners.values():
-                    q.append(payload)
+            # storage is durable: feed subscribed snapshots their delta,
+            # THEN bump the epoch (under the commit lock, so payload
+            # epochs are gap-free and a concurrent refresh() that reads
+            # the new epoch is guaranteed to find the payload already
+            # queued — see snapshot.refresh's continuity check)
+            with self._commit_lock:
+                epoch_next = self._mutation_epoch + 1
+                listeners = list(self._change_listeners.values())
+                if listeners:
+                    from titan_tpu.core.changes import change_payload
+                    payload = change_payload(self, tx,
+                                             txid if txid is not None
+                                             else epoch_next)
+                    payload["epoch"] = epoch_next
+                    for q in listeners:
+                        q.push(payload)
+                self._mutation_epoch = epoch_next
             try:
                 btx.commit_indexes()
                 # user trigger log between index commit and the SECONDARY
@@ -427,14 +438,18 @@ class StandardGraph:
         means the snapshot misses committed data)."""
         return self._mutation_epoch
 
-    def subscribe_changes(self) -> tuple[int, list]:
-        """Register an in-process change listener; every commit appends its
-        change payload (core/changes.change_payload shape) to the returned
-        list. Used by OLAP snapshots for delta refresh."""
-        self._listener_seq += 1
-        token = self._listener_seq
-        q: list = []
-        self._change_listeners[token] = q
+    def subscribe_changes(self) -> tuple[int, "ChangeQueue"]:
+        """Register an in-process change listener; every commit pushes its
+        change payload (core/changes.change_payload shape + ``epoch``) to
+        the returned queue. The registry holds it WEAKLY — keep a strong
+        reference (snapshots do) or it auto-unregisters. Used by OLAP
+        snapshots for delta refresh."""
+        from titan_tpu.core.changes import ChangeQueue
+        with self._commit_lock:
+            self._listener_seq += 1
+            token = self._listener_seq
+            q = ChangeQueue()
+            self._change_listeners[token] = q
         return token, q
 
     def unsubscribe_changes(self, token: int) -> None:

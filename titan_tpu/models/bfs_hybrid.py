@@ -3,42 +3,43 @@
 The reference executes BFS-style traversals by scanning every row through a
 vertex-program superstep (FulgoraGraphComputer.java:151-189); the TPU cost
 model is entirely different: XLA lowers *random* single-element gathers and
-scatters at a flat ~100M elem/s (PERF_NOTES.md), while *coalesced* fetches —
-columns of a [8, E/8] array (~60M cols/s = 8 edges each) and 128-wide rows
-(~10G elem/s) — are 5-50x cheaper. So the kernel design goal is: pay at
-most ONE random-access op per *examined* edge, and use direction
-optimization (Beamer et al., SC'12) to cut examined edges ~5-10x below E.
+scatters at ~113M elem/s into cache-resident tables but only ~67M elem/s
+into 100MB+ tables (HBM-latency-bound — measured, experiments/
+gather_table_size.py), while *coalesced* fetches — columns of a [8, E/8]
+array — are 5-50x cheaper per edge. Kernel design rules:
+
+* at most ONE random-access op per *examined* edge;
+* direction optimization (Beamer et al., SC'12) cuts examined edges
+  ~5-10x below E;
+* the bottom-up hit test reads a per-level FRONTIER BITMAP (n/8 bytes —
+  8.4MB at scale 26, the fast-gather regime) instead of the 4-byte dist
+  array (268MB, the slow regime): measured 1.9x on the hit test;
+* work that is usually wasted runs under ``lax.cond``: survivor
+  compaction only when survivors exist, the level-end wrap only when the
+  level is already decided (at scale 26's heavy level ALL 27M candidates
+  resolve on their first chunk — the unconditional compaction alone cost
+  ~2.5s);
+* host round trips cost 95ms-900ms through the axon tunnel (it varies by
+  day), so the cheap levels fuse into on-device ``lax.while_loop``s: the
+  HEAD loop runs the early small top-down levels in one dispatch, and the
+  ENDGAME loop finishes ALL trailing small levels (either mode would be
+  sub-second; bottom-up form needs no frontier list) in one dispatch.
 
 Layout: the out-CSR is stored transposed and 8-aligned —
 ``dstT[j, q] = neighbor j of chunk q`` with every vertex's edge segment
 padded to a multiple of 8 columns (pad = ``n+1``, out of range for the
 [n+1]-sized state arrays: pad scatters drop, pad gathers clamp to the
-never-written ``dist[n]``).
-
-Fetching a chunk of 8 consecutive edges is then ONE aligned column
-gather.
+never-written ``dist[n]``; pad BITS are never set).
 
 SYMMETRIC GRAPHS ONLY: bottom-up treats a vertex's out-neighbors as its
 potential parents, which holds iff every edge has its reverse present
 (Graph500 BFS runs on the symmetrized graph). For directed graphs use
 ``titan_tpu.models.bfs`` or symmetrize first.
 
-* Top-down level: enumerate (frontier vertex, chunk) pairs with the
-  delta-scatter+cumsum trick, column-gather all chunks, scatter-min
-  ``dist[nbr] = level+1``. Random cost: 1 scatter per frontier edge
-  (+ pad slop into the sink row).
-* Bottom-up level: keep a compacted candidate list (unvisited, deg>0).
-  Each round fetches the next 8-edge chunk per candidate (1 column
-  gather) and tests ``dist[parent] == level`` (8 random gathers); found
-  candidates drop out — the early exit that makes bottom-up cheap on
-  power-law graphs. Candidates surviving many rounds (rare: hubs with no
-  frontier parent, small non-giant components) finish in one exhaustive
-  masked sweep so a 100k-degree vertex never drives 10k host rounds.
-
-The host drives levels/rounds with ONE small stats readback per step
-(~95ms tunnel sync); all graph state stays on device, and the returned
-``dist`` is a device array (a full readback costs ~20s at scale 26 over
-the tunnel — callers that need numpy convert explicitly).
+The host drives only the HEAVY middle levels (one stats readback each);
+all graph state stays on device, and the returned ``dist`` is a device
+array (a full readback costs ~20s+ at scale 26 over the tunnel — callers
+that need numpy convert explicitly).
 """
 
 from __future__ import annotations
@@ -52,12 +53,20 @@ from titan_tpu.models.bfs import INF, _next_pow2
 # mode-switch thresholds (Beamer-style, tuned on v5e):
 # td->bu when the frontier's (chunked) edge mass exceeds 1/ALPHA of the
 # remaining unvisited edge mass; bu->td when the next frontier's edge mass
-# falls back below it. The random-op cost ratio scatter:gather is ~1:1 so
-# the classic edge-mass comparison carries over directly.
+# falls back below it. Kernels use the integer form m8_f > m8_unvis // 8
+# (m8 * 8 would overflow int32 at scale 26).
 ALPHA = 8.0
 # after this many 8-edge chunks checked per candidate, survivors go to the
 # exhaustive sweep
 BU_CHUNK_ROUNDS = 8
+# head loop caps: early top-down levels fused into one dispatch while the
+# frontier stays under these
+HEAD_F_CAP = 1 << 12
+HEAD_P_CAP = 1 << 18
+# endgame entry: remaining unvisited vertex / chunk mass caps (one fused
+# dispatch finishes every trailing level)
+END_C_CAP = 1 << 21
+END_P_CAP = 1 << 22
 
 
 def build_chunked_csr(snap):
@@ -157,6 +166,93 @@ def enumerate_chunk_pairs(valid, counts, colstarts, p_cap: int, q_pad: int,
     return cols, p_total, owner
 
 
+def _pack_bits(dist, level, n_: int):
+    """Frontier bitmap: bit v = (dist[v] == level), little-endian within
+    bytes, sized to cover index n_+1 (the pad vertex, always 0)."""
+    import jax.numpy as jnp
+
+    nbytes = (n_ + 2 + 7) // 8
+    mask = jnp.concatenate([dist == level, jnp.zeros((8,), bool)])
+    return jnp.packbits(mask[:nbytes * 8], bitorder="little")
+
+
+def _bit_of(fbits, idx):
+    """Test bitmap bits at int32 indices (any shape)."""
+    import jax.numpy as jnp
+
+    w = jnp.take(fbits, idx >> 3)
+    return ((w >> (idx & 7).astype(jnp.uint8)) & jnp.uint8(1)) \
+        .astype(bool)
+
+
+def _level_stats(dist, degc, level, n_: int):
+    """[nf, m8_next, m8_unvis, n_unvis] after a level's writes landed
+    (frontier now at dist == level+1)."""
+    import jax.numpy as jnp
+
+    changed = dist[:n_] == level + 1
+    nf = changed.sum().astype(jnp.int32)
+    m8_next = jnp.where(changed, degc[:n_], 0).sum(dtype=jnp.int32)
+    unvis = dist[:n_] >= INF
+    m8_unvis = jnp.where(unvis, degc[:n_], 0).sum(dtype=jnp.int32)
+    n_unvis = (unvis & (degc[:n_] > 0)).sum().astype(jnp.int32)
+    return jnp.stack([nf, m8_next, m8_unvis, n_unvis])
+
+
+def _head_loop():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("f_cap", "p_cap", "n_"))
+        def head(source, max_lv, dstT, colstart, degc, f_cap: int,
+                 p_cap: int, n_: int):
+            """Fused early top-down levels: run levels from the source
+            while the frontier stays within (f_cap, p_cap) and top-down
+            stays the right mode; ONE dispatch, one stats readback."""
+            q_pad = dstT.shape[1] - 1
+
+            def cond(s):
+                _, _, f_count, m8_f, m8_unvis, level, going = s
+                return going & (level < max_lv)
+
+            def body(s):
+                dist, frontier, f_count, m8_f, m8_unvis, level, _ = s
+                valid = jnp.arange(f_cap) < f_count
+                v = jnp.minimum(frontier, n_)
+                cols, _, _ = enumerate_chunk_pairs(
+                    valid, degc[v], colstart[v], p_cap, q_pad)
+                nbr = jnp.take(dstT, cols, axis=1)
+                dist = dist.at[nbr].min(level + 1, mode="drop")
+                st = _level_stats(dist, degc, level, n_)
+                nf, m8_next, m8_unvis2 = st[0], st[1], st[2]
+                changed = dist[:n_] == level + 1
+                nxt = jnp.nonzero(changed, size=f_cap,
+                                  fill_value=n_)[0].astype(jnp.int32)
+                going = (nf > 0) & (nf <= f_cap) & (m8_next <= p_cap) \
+                    & ~((m8_next > m8_unvis2 // 8) & (nf > 1))
+                return (dist, nxt, nf, m8_next, m8_unvis2, level + 1,
+                        going)
+
+            dist = jnp.full((n_ + 1,), INF, jnp.int32).at[source].set(0)
+            frontier = jnp.full((f_cap,), n_, jnp.int32) \
+                .at[0].set(source)
+            m8_f = degc[source]
+            m8_unvis = jnp.where(dist[:n_] >= INF, degc[:n_], 0) \
+                .sum(dtype=jnp.int32)
+            state = (dist, frontier, jnp.int32(1), m8_f, m8_unvis,
+                     jnp.int32(0), (m8_f <= p_cap) & (m8_f > 0))
+            dist, frontier, f_count, m8_f, m8_unvis, level, _ = \
+                jax.lax.while_loop(cond, body, state)
+            n_unvis = ((dist[:n_] >= INF) & (degc[:n_] > 0)) \
+                .sum().astype(jnp.int32)
+            return dist, frontier, jnp.stack(
+                [f_count, m8_f, m8_unvis, n_unvis, level])
+        return head
+    return _get("hybrid_head", build)
+
+
 def _td_step():
     def build():
         import jax
@@ -173,50 +269,84 @@ def _td_step():
                 valid, degc[v], colstart[v], p_cap, dstT.shape[1] - 1)
             nbr = jnp.take(dstT, cols, axis=1)   # [8, p_cap], pad = n+1
             dist = dist.at[nbr].min(level + 1, mode="drop")
-
             changed = dist[:n_] == level + 1
-            nf = changed.sum().astype(jnp.int32)
             next_frontier = jnp.nonzero(
                 changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
-            m8_next = jnp.where(changed, degc[:n_], 0) \
-                .sum(dtype=jnp.int32)
-            unvis = dist[:n_] >= INF
-            m8_unvis = jnp.where(unvis, degc[:n_], 0).sum(dtype=jnp.int32)
-            n_unvis = unvis.sum().astype(jnp.int32)
-            stats = jnp.stack([nf, m8_next, m8_unvis, n_unvis]) \
-                .astype(jnp.int32)
-            return dist, next_frontier, stats
+            return dist, next_frontier, _level_stats(dist, degc, level, n_)
         return td
     return _get("hybrid_td", build)
 
 
-def _bu_rounds():
+def _bu_start():
     def build():
         import jax
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
-                           static_argnames=("c_cap", "src_cap", "n_",
-                                            "fuse"),
+                           static_argnames=("c_cap", "n_"),
                            donate_argnums=(0,))
-        def bu(dist, cand, off, c_count, cand_level, c_level_count, level,
-               dstT, colstart, degc, c_cap: int, src_cap: int, n_: int,
-               fuse: int):
-            """``fuse`` chunk-check rounds over the active candidate list,
-            PLUS the level-end wrap outputs (next level's candidate list +
-            mode-decision stats) computed unconditionally — when no
-            survivors remain the host skips the separate wrap call, one
-            fewer ~95ms tunnel sync per bottom-up level. The wrap is
-            discarded when survivors remain (typically once, on the heavy
-            level's first dispatch): ~tens of ms of n-scale reductions
-            wasted there vs a sync saved on every straggler-free level —
-            measured net win; revisit if src_cap compile variants bloat.
+        def bu0(dist, level, dstT, colstart, degc, c_cap: int, n_: int):
+            """Bottom-up level opener, fully fused: build the candidate
+            list from dist (the old separate all_unvis dispatch), check
+            chunk 0 of every candidate against the frontier BITMAP, then
+            - survivors > 0: compact them (lax.cond — skipped at heavy
+              levels where chunk 0 decides everyone);
+            - survivors == 0: level done — emit the level-end stats
+              (lax.cond, so it costs nothing when survivors remain).
+            Caller guarantee: count(unvisited & deg>0) <= c_cap."""
+            q_pad = dstT.shape[1] - 1
+            fbits = _pack_bits(dist, level, n_)
+            unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
+            cand = jnp.nonzero(unvis, size=c_cap,
+                               fill_value=n_)[0].astype(jnp.int32)
+            c_count = unvis.sum().astype(jnp.int32)
 
-            cand: [c_cap] vertex ids (pad n_), off: [c_cap] chunk progress.
-            Found candidates get dist=level+1 and drop out; exhausted
-            candidates (all chunks checked, no hit) drop out too.
-            cand_level: [src_cap] the level's full candidate list.
-            """
+            alive = jnp.arange(c_cap) < c_count
+            v = jnp.minimum(cand, n_)
+            cols = jnp.where(alive, colstart[v], q_pad)
+            parents = jnp.take(dstT, jnp.clip(cols, 0, q_pad), axis=1)
+            hit = _bit_of(fbits, parents)
+            found = alive & hit.any(axis=0)
+            dist = dist.at[jnp.where(found, v, n_ + 1)].set(
+                level + 1, mode="drop")
+            surv = alive & ~found & (degc[v] > 1)
+            nc = surv.sum().astype(jnp.int32)
+
+            def compact(_):
+                idx = jnp.nonzero(surv, size=c_cap,
+                                  fill_value=c_cap - 1)[0]
+                keep = jnp.arange(c_cap) < nc
+                cand2 = jnp.where(keep, cand[idx], n_)
+                rem8 = jnp.where(surv, degc[v] - 1, 0) \
+                    .sum(dtype=jnp.int32)
+                return cand2.astype(jnp.int32), rem8
+
+            def no_compact(_):
+                return jnp.full((c_cap,), n_, jnp.int32), jnp.int32(0)
+
+            cand2, rem8 = jax.lax.cond(nc > 0, compact, no_compact, None)
+            st = jax.lax.cond(
+                nc == 0,
+                lambda _: _level_stats(dist, degc, level, n_),
+                lambda _: jnp.zeros((4,), jnp.int32), None)
+            return dist, fbits, cand2, jnp.stack([nc, rem8]), st
+        return bu0
+    return _get("hybrid_bu_start", build)
+
+
+def _bu_more():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("c_cap", "n_", "fuse"),
+                           donate_argnums=(0,))
+        def bu(dist, fbits, cand, off, c_count, level, dstT, colstart,
+               degc, c_cap: int, n_: int, fuse: int):
+            """``fuse`` chunk-check rounds over the compacted survivor
+            list (bitmap hit test), with the level-end stats under
+            lax.cond when the survivors die out inside."""
             q_pad = dstT.shape[1] - 1
 
             def round_(state, _):
@@ -224,15 +354,15 @@ def _bu_rounds():
                 alive = jnp.arange(c_cap) < c_count
                 v = jnp.minimum(cand, n_)
                 cols = jnp.where(alive, colstart[v] + off, q_pad)
-                cols = jnp.clip(cols, 0, q_pad)
-                parents = jnp.take(dstT, cols, axis=1)   # [8, c_cap]
-                # pad lanes hold n_+1 -> gather clamps to dist[n_] = INF
-                hit = dist[parents] == level
+                parents = jnp.take(dstT, jnp.clip(cols, 0, q_pad),
+                                   axis=1)
+                hit = _bit_of(fbits, parents)
                 found = alive & hit.any(axis=0)
                 dist = dist.at[jnp.where(found, v, n_ + 1)].set(
                     level + 1, mode="drop")
                 surv = alive & ~found & (off + 1 < degc[v])
-                idx = jnp.nonzero(surv, size=c_cap, fill_value=c_cap - 1)[0]
+                idx = jnp.nonzero(surv, size=c_cap,
+                                  fill_value=c_cap - 1)[0]
                 nc = surv.sum().astype(jnp.int32)
                 keep = jnp.arange(c_cap) < nc
                 cand = jnp.where(keep, cand[idx], n_)
@@ -241,29 +371,17 @@ def _bu_rounds():
 
             (dist, cand, off, c_count), _ = jax.lax.scan(
                 round_, (dist, cand, off, c_count), None, length=fuse)
-            # remaining chunk mass of survivors (sizes the exhaustive sweep)
             alive = jnp.arange(c_cap) < c_count
             v = jnp.minimum(cand, n_)
             rem = jnp.where(alive, jnp.maximum(degc[v] - off, 0), 0) \
                 .sum(dtype=jnp.int32)
-            # fused level-end wrap (valid when c_count == 0)
-            lvalid = jnp.arange(src_cap) < c_level_count
-            lv = jnp.minimum(cand_level, n_)
-            unvis = lvalid & (dist[lv] >= INF) & (degc[lv] > 0)
-            idx = jnp.nonzero(unvis, size=src_cap,
-                              fill_value=src_cap - 1)[0]
-            nc = unvis.sum().astype(jnp.int32)
-            keep = jnp.arange(src_cap) < nc
-            cand_next = jnp.where(keep, lv[idx], n_).astype(jnp.int32)
-            changed = dist[:n_] == level + 1
-            nf = changed.sum().astype(jnp.int32)
-            m8_next = jnp.where(changed, degc[:n_], 0).sum(dtype=jnp.int32)
-            m8_unvis = jnp.where(dist[:n_] >= INF, degc[:n_], 0) \
-                .sum(dtype=jnp.int32)
-            return dist, cand, off, cand_next, jnp.stack(
-                [c_count, rem, nc, nf, m8_next, m8_unvis])
+            st = jax.lax.cond(
+                c_count == 0,
+                lambda _: _level_stats(dist, degc, level, n_),
+                lambda _: jnp.zeros((4,), jnp.int32), None)
+            return dist, cand, off, jnp.stack([c_count, rem]), st
         return bu
-    return _get("hybrid_bu", build)
+    return _get("hybrid_bu_more", build)
 
 
 def _bu_exhaust():
@@ -274,10 +392,11 @@ def _bu_exhaust():
         @functools.partial(jax.jit,
                            static_argnames=("c_cap", "p_cap", "n_"),
                            donate_argnums=(0,))
-        def ex(dist, cand, off, c_count, level, dstT, colstart, degc,
-               c_cap: int, p_cap: int, n_: int):
+        def ex(dist, fbits, cand, off, c_count, level, dstT, colstart,
+               degc, c_cap: int, p_cap: int, n_: int):
             """One masked sweep over ALL remaining chunks of the surviving
-            candidates (rare: frontier-less hubs / small components)."""
+            candidates (rare: frontier-less hubs / small components), then
+            the level-end stats (always needed here)."""
             valid = jnp.arange(c_cap) < c_count
             v = jnp.minimum(cand, n_)
             rem = jnp.maximum(degc[v] - off, 0)
@@ -285,7 +404,7 @@ def _bu_exhaust():
                 valid, rem, colstart[v] + off, p_cap, dstT.shape[1] - 1,
                 with_owner=True)
             parents = jnp.take(dstT, cols, axis=1)       # [8, p_cap]
-            hit = (dist[parents] == level).any(axis=0)   # [p_cap]
+            hit = _bit_of(fbits, parents).any(axis=0)    # [p_cap]
             # per-candidate any-hit: scatter-max of hit through the
             # pair -> candidate mapping
             j = jnp.arange(p_cap, dtype=jnp.int32)
@@ -295,39 +414,63 @@ def _bu_exhaust():
             found = valid & (found_per > 0)
             dist = dist.at[jnp.where(found, v, n_ + 1)].set(
                 level + 1, mode="drop")
-            return dist
+            return dist, _level_stats(dist, degc, level, n_)
         return ex
     return _get("hybrid_ex", build)
 
 
-def _bu_wrap():
+def _endgame():
     def build():
         import jax
         import jax.numpy as jnp
 
-        @functools.partial(jax.jit, static_argnames=("n_", "src_cap"))
-        def wrap(dist, src_list, src_count, level, degc, n_: int,
-                 src_cap: int):
-            """Bottom-up level end, fused: next level's candidate list
-            (entries of ``src_list`` still unvisited) + the scalar stats
-            the mode decision needs. No n-scale nonzero — the frontier
-            LIST is only built (lazily, `_frontier_of`) when switching
-            back to top-down."""
-            valid = jnp.arange(src_cap) < src_count
-            v = jnp.minimum(src_list, n_)
-            unvis = valid & (dist[v] >= INF) & (degc[v] > 0)
-            idx = jnp.nonzero(unvis, size=src_cap, fill_value=src_cap - 1)[0]
-            nc = unvis.sum().astype(jnp.int32)
-            keep = jnp.arange(src_cap) < nc
-            out = jnp.where(keep, v[idx], n_).astype(jnp.int32)
-            changed = dist[:n_] == level + 1
-            nf = changed.sum().astype(jnp.int32)
-            m8_next = jnp.where(changed, degc[:n_], 0).sum(dtype=jnp.int32)
-            m8_unvis = jnp.where(dist[:n_] >= INF, degc[:n_], 0) \
-                .sum(dtype=jnp.int32)
-            return out, jnp.stack([nc, nf, m8_next, m8_unvis])
-        return wrap
-    return _get("hybrid_bu_wrap", build)
+        @functools.partial(jax.jit,
+                           static_argnames=("c_cap", "p_cap", "n_"),
+                           donate_argnums=(0,))
+        def end(dist, level0, max_lv, dstT, colstart, degc, c_cap: int,
+                p_cap: int, n_: int):
+            """Finish the BFS: run EVERY remaining level in one dispatch.
+            Each iteration is a full bottom-up level over the (shrinking)
+            unvisited set — candidate count and chunk mass are bounded by
+            the entry caps, so shapes are static and the loop needs no
+            host round trips. Terminates when a level finds nothing.
+            Caller guarantee: n_unvis <= c_cap and m8_unvis <= p_cap."""
+            q_pad = dstT.shape[1] - 1
+
+            def cond(s):
+                _, level, found, _ = s
+                return (found > 0) & (level < max_lv)
+
+            def body(s):
+                dist, level, _, iters = s
+                fbits = _pack_bits(dist, level, n_)
+                unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
+                cand = jnp.nonzero(unvis, size=c_cap,
+                                   fill_value=n_)[0].astype(jnp.int32)
+                c_count = unvis.sum().astype(jnp.int32)
+                valid = jnp.arange(c_cap) < c_count
+                v = jnp.minimum(cand, n_)
+                cols, p_total, owner = enumerate_chunk_pairs(
+                    valid, degc[v], colstart[v], p_cap, q_pad,
+                    with_owner=True)
+                parents = jnp.take(dstT, cols, axis=1)
+                hit = _bit_of(fbits, parents).any(axis=0)
+                j = jnp.arange(p_cap, dtype=jnp.int32)
+                found_per = jnp.zeros((c_cap,), jnp.int32) \
+                    .at[jnp.where(j < p_total, owner, c_cap - 1)] \
+                    .max(hit.astype(jnp.int32), mode="drop")
+                found = valid & (found_per > 0)
+                dist = dist.at[jnp.where(found, v, n_ + 1)].set(
+                    level + 1, mode="drop")
+                nfound = found.sum().astype(jnp.int32)
+                return (dist, level + 1, nfound,
+                        iters + (nfound > 0).astype(jnp.int32))
+
+            state = (dist, level0, jnp.int32(1), jnp.int32(0))
+            dist, _, _, iters = jax.lax.while_loop(cond, body, state)
+            return dist, iters
+        return end
+    return _get("hybrid_endgame", build)
 
 
 def _frontier_of():
@@ -344,20 +487,6 @@ def _frontier_of():
     return _get("hybrid_frontier_of", build)
 
 
-def _all_unvisited():
-    def build():
-        import jax
-        import jax.numpy as jnp
-
-        @functools.partial(jax.jit, static_argnames=("n_",))
-        def au(dist, degc, n_: int):
-            unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
-            idx = jnp.nonzero(unvis, size=n_, fill_value=n_)[0]
-            return idx.astype(jnp.int32), unvis.sum().astype(jnp.int32)
-        return au
-    return _get("hybrid_all_unvis", build)
-
-
 def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
                         return_device: bool = False):
     """Direction-optimizing BFS. Returns (dist, levels); ``dist`` is a
@@ -371,12 +500,13 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
     g = snap if isinstance(snap, dict) else build_chunked_csr(snap)
     n = g["n"]
     dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
+    head = _head_loop()
     td = _td_step()
-    bu = _bu_rounds()
+    bu0 = _bu_start()
+    bu = _bu_more()
     ex = _bu_exhaust()
-    buwrap = _bu_wrap()
+    endgame = _endgame()
     frontier_of = _frontier_of()
-    all_unvis = _all_unvisited()
 
     total_chunks = int((g["q_total"] - 1))
     cap_n = _next_pow2(max(n, 2))
@@ -389,28 +519,35 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
                 [a, jnp.full((cap_n - a.shape[0],), n, a.dtype)])
         return a
 
-    dist = jnp.full((n + 1,), INF, jnp.int32).at[source_dense].set(0)
-    frontier = pad(jnp.full((1,), source_dense, jnp.int32))
-    f_count = 1
-    m8_f = int(np.asarray(degc[source_dense]))
-    m8_unvis = total_chunks - m8_f
-    mode = "td"
-    cand = None
-    c_count = 0
-    level = 0
-    while f_count > 0 and level < max_levels:
-        use_bu = m8_f * ALPHA > m8_unvis and f_count > 1
-        if use_bu and mode == "td":
-            cand, c_count = all_unvis(dist, degc, n_=n)
-            cand = pad(cand)
-            mode = "bu"
-        elif not use_bu:
-            mode = "td"
+    # ---- fused head: source + early top-down levels, one readback
+    f_cap_h = min(HEAD_F_CAP, cap_n)
+    p_cap_h = min(HEAD_P_CAP, _next_pow2(max(total_chunks + n, 2)))
+    dist, frontier, st = head(jnp.int32(source_dense),
+                              jnp.int32(max_levels), dstT, colstart,
+                              degc, f_cap=f_cap_h, p_cap=p_cap_h, n_=n)
+    f_count, m8_f, m8_unvis, n_unvis, level = \
+        (int(x) for x in np.asarray(st))
+    # head refusal (source mass > p_cap_h) returns its initial state:
+    # f_count=1, frontier=[source], level=0 — the main loop just takes over
+    frontier = pad(frontier) if f_count <= f_cap_h else None
 
-        if mode == "td":
+    while f_count > 0 and level < max_levels:
+        # ---- fused endgame: every remaining level in one dispatch
+        if n_unvis <= END_C_CAP and m8_unvis <= END_P_CAP:
+            c_cap = _next_pow2(max(n_unvis, 2))
+            p_cap = _next_pow2(max(m8_unvis, 2))
+            dist, iters = endgame(dist, jnp.int32(level),
+                                  jnp.int32(max_levels), dstT, colstart,
+                                  degc, c_cap=c_cap, p_cap=p_cap, n_=n)
+            # +1: the empty probe level, matching the host loop's count
+            level = min(level + int(np.asarray(iters)) + 1, max_levels)
+            break
+
+        use_bu = m8_f * ALPHA > m8_unvis and f_count > 1
+        if not use_bu:
             if m8_f == 0:
                 break
-            if frontier is None:      # just switched back from bottom-up
+            if frontier is None:      # after bottom-up / head overflow
                 frontier = pad(frontier_of(dist, jnp.int32(level), n_=n))
             f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
             p_cap = min(_next_pow2(max(m8_f, 2)),
@@ -420,55 +557,42 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
                 jnp.int32(level), dstT, colstart, degc,
                 f_cap=f_cap, p_cap=p_cap, n_=n)
             frontier = pad(frontier)
-            f_count, m8_f, m8_unvis, _ = (int(x) for x in np.asarray(st))
+            f_count, m8_f, m8_unvis, n_unvis = \
+                (int(x) for x in np.asarray(st))
         else:
-            # bottom-up: candidates = this level's unvisited list
-            c_count = int(c_count)
-            active = cand
-            a_count = c_count
-            src_cap = min(_next_pow2(max(c_count, 2)), cap_n)
-            off = jnp.zeros(active.shape, jnp.int32)
-            rounds = 0
-            rem_total = total_chunks
-            wrap_stats = None
-            while a_count > 0 and rounds < BU_CHUNK_ROUNDS:
-                c_cap = min(_next_pow2(max(a_count, 2)), cap_n)
-                # first call checks ONE chunk (most candidates are decided
-                # by it on power-law graphs, so later rounds run at the
-                # surviving width); the second covers every remaining
-                # round in one dispatch
-                fuse = 1 if rounds == 0 else BU_CHUNK_ROUNDS - rounds
-                dist, active, off, cand_next, st = bu(
-                    dist, active[:c_cap], off[:c_cap], jnp.int32(a_count),
-                    cand[:src_cap], jnp.int32(c_count), jnp.int32(level),
-                    dstT, colstart, degc, c_cap=c_cap, src_cap=src_cap,
-                    n_=n, fuse=fuse)
-                sth = [int(x) for x in np.asarray(st)]
-                a_count, rem_total = sth[0], sth[1]
-                if a_count == 0:
-                    wrap_stats = (cand_next, sth[2], sth[3], sth[4],
-                                  sth[5])
+            c_cap = min(_next_pow2(max(n_unvis, 2)), cap_n)
+            dist, fbits, cand, prog, st = bu0(
+                dist, jnp.int32(level), dstT, colstart, degc,
+                c_cap=c_cap, n_=n)
+            nc, rem8 = (int(x) for x in np.asarray(prog))
+            rounds = 1
+            off = None
+            while nc > 0 and rounds < BU_CHUNK_ROUNDS:
+                c_cap2 = min(_next_pow2(max(nc, 2)), cap_n)
+                if off is None:
+                    cand = pad(cand)
+                    off = jnp.ones((cap_n,), jnp.int32)
+                fuse = BU_CHUNK_ROUNDS - rounds
+                dist, cand, off, prog, st = bu(
+                    dist, fbits, cand[:c_cap2], off[:c_cap2],
+                    jnp.int32(nc), jnp.int32(level), dstT, colstart,
+                    degc, c_cap=c_cap2, n_=n, fuse=fuse)
+                cand, off = pad(cand), pad(off)
+                nc, rem8 = (int(x) for x in np.asarray(prog))
                 rounds += fuse
-            if a_count > 0:
-                # exhaustive sweep for the stragglers
-                c_cap = min(_next_pow2(max(a_count, 2)), cap_n)
-                rem_cap = _next_pow2(max(rem_total, 2))
-                dist = ex(dist, active[:c_cap], off[:c_cap],
-                          jnp.int32(a_count), jnp.int32(level), dstT,
-                          colstart, degc, c_cap=c_cap, p_cap=rem_cap,
-                          n_=n)
-                wrap_stats = None     # dist changed after the fused wrap
-            if wrap_stats is not None:
-                cand, c_count, f_count, m8_f, m8_unvis = wrap_stats
-                cand = pad(cand)
-            else:
-                # stragglers ran: recompute the level end from final dist
-                cand, st = buwrap(dist, cand[:src_cap],
-                                  jnp.int32(c_count), jnp.int32(level),
-                                  degc, n_=n, src_cap=src_cap)
-                cand = pad(cand)
-                c_count, f_count, m8_f, m8_unvis = \
-                    (int(x) for x in np.asarray(st))
+            if nc > 0:
+                # exhaustive sweep for the stragglers (stats included)
+                c_cap2 = min(_next_pow2(max(nc, 2)), cap_n)
+                rem_cap = _next_pow2(max(rem8, 2))
+                if off is None:
+                    cand = pad(cand)
+                    off = jnp.ones((cap_n,), jnp.int32)
+                dist, st = ex(dist, fbits, cand[:c_cap2], off[:c_cap2],
+                              jnp.int32(nc), jnp.int32(level), dstT,
+                              colstart, degc, c_cap=c_cap2,
+                              p_cap=rem_cap, n_=n)
+            f_count, m8_f, m8_unvis, n_unvis = \
+                (int(x) for x in np.asarray(st))
             frontier = None
         level += 1
     out = dist[:n]

@@ -94,14 +94,34 @@ class GraphSnapshot:
             raise NotImplementedError(
                 "refresh() with extracted edge_values: change payloads "
                 "don't carry edge properties — rebuild the snapshot")
-        new_epoch = g.mutation_epoch
         q = self._listener
+        if getattr(q, "overflowed", False):
+            raise RuntimeError(
+                "change backlog overflowed (>10k commits since the last "
+                "refresh) — delta refresh is unsound; rebuild the "
+                "snapshot")
+        new_epoch = g.mutation_epoch
         pending: list = []
-        while q:                 # pop-drain: a concurrent commit's append
-            pending.append(q.pop(0))   # is never lost (worst case it is
-        #                              # applied now AND epoch stays behind
-        #                              # -> one extra no-op refresh later)
-        stats = self.apply_changes(pending, g.schema, g.idm)
+        while q:                 # pop-drain: a commit that bumped the
+            pending.append(q.pop(0))   # epoch we read has ALREADY queued
+        #                              # its payload (commit pushes before
+        #                              # bumping, under the commit lock)
+        # continuity: the payloads must cover exactly
+        # (self.epoch, new_epoch] — a gap means commits this listener
+        # never saw (e.g. they landed during build()'s store scan), and
+        # applying around the hole would corrupt the CSR
+        epochs = [p.get("epoch") for p in pending]
+        covered = [e for e in epochs if e is not None
+                   and self.epoch < e <= new_epoch]
+        if len(covered) != new_epoch - self.epoch:
+            raise RuntimeError(
+                f"snapshot delta gap: epochs ({self.epoch}, {new_epoch}] "
+                f"but only {len(covered)} payloads — commits landed "
+                "concurrently with build()'s scan; rebuild the snapshot")
+        stats = self.apply_changes(
+            [p for p in pending
+             if p.get("epoch") is None or p["epoch"] > self.epoch],
+            g.schema, g.idm)
         self.epoch = new_epoch
         return stats
 
@@ -198,8 +218,15 @@ class GraphSnapshot:
             labs = np.concatenate([labs, a_l])
         ids = np.asarray(sorted((set(old_ids.tolist()) | new_vids)
                                 - dead_vids), np.int64)
-        si = np.searchsorted(ids, src_ids)
-        di = np.searchsorted(ids, dst_ids)
+        si = np.clip(np.searchsorted(ids, src_ids), 0, max(len(ids) - 1, 0))
+        di = np.clip(np.searchsorted(ids, dst_ids), 0, max(len(ids) - 1, 0))
+        # drop rows whose endpoint is not a live vertex (an added edge
+        # can reference a vertex a LATER pending commit removed, or a
+        # ghost id): exactly build()'s endpoint validation
+        ok = np.ones(len(src_ids), bool)
+        if len(ids):
+            ok = (ids[si] == src_ids) & (ids[di] == dst_ids)
+        si, di, labs = si[ok], di[ok], labs[ok]
         rebuilt = from_arrays(len(ids), si.astype(np.int32),
                               di.astype(np.int32), ids, None, labs,
                               self.label_names)
@@ -435,6 +462,11 @@ def build(graph, labels: Optional[Sequence[str]] = None,
     idm = graph.idm
     schema = graph.schema
     codec = graph.codec
+    # epoch is captured BEFORE the scan: a commit racing the scan bumps
+    # past it, flipping `stale` true; since the listener (subscribed
+    # after the scan) missed that payload, refresh()'s continuity check
+    # fails loud and demands a rebuild instead of silently corrupting
+    epoch0 = graph.mutation_epoch
     label_ids = None
     if labels is not None:
         label_ids = {st.id for name in labels
@@ -491,9 +523,10 @@ def build(graph, labels: Optional[Sequence[str]] = None,
         if st is not None:
             label_names[code] = st.name
     snap = from_arrays(n, src, dst, vertex_ids, evs, labs_arr, label_names)
-    # freshness contract: stamp the epoch and subscribe for deltas so
-    # refresh() can catch this snapshot up without a store re-scan
-    snap.epoch = graph.mutation_epoch
+    # freshness contract: stamp the pre-scan epoch and subscribe for
+    # deltas so refresh() can catch this snapshot up without a store
+    # re-scan (see epoch0 note above for the race semantics)
+    snap.epoch = epoch0
     snap._graph = graph
     snap._listener_token, snap._listener = graph.subscribe_changes()
     snap._build_params = {"label_ids": label_ids, "directed": directed}
