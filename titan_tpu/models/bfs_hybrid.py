@@ -433,21 +433,19 @@ def _endgame():
             Each iteration is a full bottom-up level over the (shrinking)
             unvisited set — candidate count and chunk mass are bounded by
             the entry caps, so shapes are static and the loop needs no
-            host round trips. Terminates when a level finds nothing.
+            host round trips. The candidate list is built ONCE (one
+            n-scale nonzero) and compacted at c_cap width between
+            iterations. Terminates when a level finds nothing.
             Caller guarantee: n_unvis <= c_cap and m8_unvis <= p_cap."""
             q_pad = dstT.shape[1] - 1
 
             def cond(s):
-                _, level, found, _ = s
+                _, _, _, level, found, _ = s
                 return (found > 0) & (level < max_lv)
 
             def body(s):
-                dist, level, _, iters = s
+                dist, cand, c_count, level, _, iters = s
                 fbits = _pack_bits(dist, level, n_)
-                unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
-                cand = jnp.nonzero(unvis, size=c_cap,
-                                   fill_value=n_)[0].astype(jnp.int32)
-                c_count = unvis.sum().astype(jnp.int32)
                 valid = jnp.arange(c_cap) < c_count
                 v = jnp.minimum(cand, n_)
                 cols, p_total, owner = enumerate_chunk_pairs(
@@ -463,11 +461,23 @@ def _endgame():
                 dist = dist.at[jnp.where(found, v, n_ + 1)].set(
                     level + 1, mode="drop")
                 nfound = found.sum().astype(jnp.int32)
-                return (dist, level + 1, nfound,
+                # compact survivors at c_cap width (no n-scale pass)
+                surv = valid & ~found
+                idx = jnp.nonzero(surv, size=c_cap,
+                                  fill_value=c_cap - 1)[0]
+                nc = surv.sum().astype(jnp.int32)
+                keep = jnp.arange(c_cap) < nc
+                cand = jnp.where(keep, v[idx], n_).astype(jnp.int32)
+                return (dist, cand, nc, level + 1, nfound,
                         iters + (nfound > 0).astype(jnp.int32))
 
-            state = (dist, level0, jnp.int32(1), jnp.int32(0))
-            dist, _, _, iters = jax.lax.while_loop(cond, body, state)
+            unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
+            cand0 = jnp.nonzero(unvis, size=c_cap,
+                                fill_value=n_)[0].astype(jnp.int32)
+            c0 = unvis.sum().astype(jnp.int32)
+            state = (dist, cand0, c0, level0, jnp.int32(1), jnp.int32(0))
+            dist, _, _, _, _, iters = jax.lax.while_loop(cond, body,
+                                                         state)
             return dist, iters
         return end
     return _get("hybrid_endgame", build)
