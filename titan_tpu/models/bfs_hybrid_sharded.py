@@ -38,7 +38,8 @@ import functools
 import numpy as np
 
 from titan_tpu.models.bfs import INF, _next_pow2
-from titan_tpu.models.bfs_hybrid import enumerate_chunk_pairs
+from titan_tpu.models.bfs_hybrid import (_bit_of, _pack_bits,
+                                         enumerate_chunk_pairs)
 from titan_tpu.utils.jitcache import jit_once
 
 ALPHA = 8.0
@@ -251,6 +252,11 @@ def _bu_level():
             def per_shard(dist, dstT_l, cs_l, degc_l, lo, hi):
                 dstT_l, cs_l, degc_l = dstT_l[0], cs_l[0], degc_l[0]
                 lo, hi = lo[0], hi[0]
+                # frontier bitmap: the dist replica is a 100MB+ table at
+                # bench scale (slow random-gather regime); the n/8-byte
+                # bitmap restores the fast regime — see bfs_hybrid module
+                # doc + experiments/gather_table_size.py
+                fbits = _pack_bits(dist, level, n_)
                 block = jnp.arange(b_max, dtype=jnp.int32)
                 cand_mask = (block < hi - lo) \
                     & (dist[jnp.minimum(block + lo, n_)] >= INF) \
@@ -268,7 +274,7 @@ def _bu_level():
                     cols = jnp.where(alive, cs_l[lv] + off, q_pad)
                     parents = jnp.take(dstT_l,
                                        jnp.clip(cols, 0, q_pad), axis=1)
-                    hit = dist[parents] == level
+                    hit = _bit_of(fbits, parents)
                     found = alive & hit.any(axis=0)
                     gv = jnp.where(found, lv + lo, n_ + 1)
                     dist = dist.at[gv].set(level + 1, mode="drop")
@@ -292,7 +298,7 @@ def _bu_level():
                     alive, rem, cs_l[lv] + off, p_cap, q_pad,
                     with_owner=True)
                 parents = jnp.take(dstT_l, cols, axis=1)
-                hit = (dist[parents] == level).any(axis=0)
+                hit = _bit_of(fbits, parents).any(axis=0)
                 j = jnp.arange(p_cap, dtype=jnp.int32)
                 found_per = jnp.zeros((c_cap,), jnp.int32) \
                     .at[jnp.where(j < p_total, owner, c_cap - 1)] \
