@@ -362,3 +362,46 @@ def test_misplaced_modulator_raises(gods):
         gods.traversal().V().option("x", anon().out()).to_list()
     with pytest.raises(ValueError):
         gods.traversal().V().times(3).to_list()
+
+
+# ---------------------------------------------------------------- match
+
+def test_match_chain(gods):
+    out = gods.traversal().V().has("name", "hercules").match(
+        anon().as_("h").out("father").as_("f"),
+        anon().as_("f").out("father").as_("gf"),
+    ).select("gf").by("name").to_list()
+    assert out == ["saturn"]
+
+
+def test_match_join_constraint(gods):
+    # b must satisfy BOTH patterns: jupiter's brother AND a pet owner
+    rows = gods.traversal().V().has("name", "jupiter").match(
+        anon().as_("a").out("brother").as_("b"),
+        anon().as_("b").out("pet").as_("p"),
+    ).select("b", "p").by("name").by("name").to_list()
+    assert rows == [{"b": "pluto", "p": "cerberus"}]
+
+
+def test_match_shared_end_var_joins(gods):
+    # both hercules and cerberus relate to the same target: father=jupiter
+    # vs lives=tartarus never join; father=jupiter vs battled works via
+    # two patterns from the same start
+    rows = gods.traversal().V().has("name", "hercules").match(
+        anon().as_("h").out("battled").as_("m"),
+        anon().as_("m").out("lives").as_("place"),
+    ).select("m", "place").by("name").by("name").to_list()
+    assert {"m": "cerberus", "place": "tartarus"} in rows
+
+
+def test_match_disconnected_raises(gods):
+    with pytest.raises(ValueError, match="bound variable"):
+        gods.traversal().V().has("name", "jupiter").match(
+            anon().as_("x").out("brother").as_("y"),
+            anon().as_("unrelated").out("pet").as_("p"),
+        ).to_list()
+
+
+def test_match_without_start_as_raises(gods):
+    with pytest.raises(ValueError, match="as_"):
+        gods.traversal().V().match(anon().out("brother")).to_list()

@@ -382,6 +382,17 @@ class Traversal:
         self._absorb_path(sub)
         return self._append("local", sub)
 
+    def match(self, *patterns: "Traversal"):
+        """TP3 MatchStep (conjunctive subset): each pattern is an
+        anonymous traversal that STARTS at a variable — written
+        ``anon().as_("a")...`` — and usually ENDS with ``.as_("b")``
+        binding the result. Patterns join on shared variable names; the
+        incoming traverser seeds the FIRST pattern's start variable.
+        Emits one traverser per consistent binding (its object is the
+        binding dict — follow with ``select`` to project variables)."""
+        self._absorb_path(*patterns)
+        return self._append("match", patterns)
+
     def sack(self, op: Optional[Callable] = None):
         """No-arg: read the sack into the stream. With ``op(sack, operand)``:
         update the sack; operand is the ``by`` modulator's value (default:
@@ -692,6 +703,49 @@ class Traversal:
     def _seeded(self, tx, t: Traverser, sub: "Traversal") -> list:
         """Run sub seeded with a clone of one traverser; list of results."""
         return list(self._apply_sub(tx, iter([t.split(t.bulk)]), sub))
+
+    @staticmethod
+    def _binding_eq(a, b) -> bool:
+        if isinstance(a, (Vertex, Edge)) and isinstance(b, (Vertex, Edge)):
+            return type(a) is type(b) and a.id == b.id
+        return a == b
+
+    def _match_solve(self, tx, bindings: dict, patterns: list
+                     ) -> Iterator[dict]:
+        """Backtracking pattern join (TP3 MatchStep, conjunctive subset):
+        pick a pattern whose start variable is bound, enumerate its
+        solutions, extend/check bindings, recurse on the rest."""
+        if not patterns:
+            yield bindings
+            return
+        for k, pat in enumerate(patterns):
+            if pat._steps[0][1][0] in bindings:
+                chosen, rest = pat, patterns[:k] + patterns[k + 1:]
+                break
+        else:
+            names = [p._steps[0][1][0] for p in patterns]
+            raise ValueError(
+                f"match(): none of the remaining patterns {names} starts "
+                "at a bound variable (patterns must be connected)")
+        start = chosen._steps[0][1][0]
+        body = chosen._steps[1:]
+        end_var = None
+        if body and body[-1][0] == "as":
+            end_var = body[-1][1][0]
+            body = body[:-1]
+        sub = Traversal(None)
+        sub._steps = list(body)
+        sub._path_needed = chosen._path_needed
+        seed = Traverser(bindings[start], labels=dict(bindings))
+        for r in self._apply_sub(tx, iter([seed]), sub):
+            newb = dict(bindings)
+            newb.update(r.labels)      # as_ bindings made inside the body
+            if end_var is not None:
+                if end_var in bindings and \
+                        not self._binding_eq(bindings[end_var], r.obj):
+                    continue           # join constraint violated
+                newb[end_var] = r.obj
+            yield from self._match_solve(tx, newb, rest)
 
     def _matches(self, tx, t: Traverser, cond) -> bool:
         """Filter condition: callable on the object, or an anonymous
@@ -1028,6 +1082,26 @@ class Traversal:
                 for t in ts:
                     yield from self._seeded(tx, t, sub)
             return flocal()
+        if name == "match":
+            patterns = args[0]
+            if not patterns:
+                raise ValueError("match() needs at least one pattern")
+            for pat in patterns:
+                if not pat._steps or pat._steps[0][0] != "as":
+                    raise ValueError(
+                        "match() patterns must start with as_(<var>)")
+
+            def fmatch(ts=traversers):
+                start0 = patterns[0]._steps[0][1][0]
+                for t in ts:
+                    bindings0 = dict(t.labels)
+                    bindings0[start0] = t.obj
+                    for b in self._match_solve(tx, bindings0,
+                                               list(patterns)):
+                        nt = t.extend(b)
+                        nt.labels = b    # select() projects variables
+                        yield nt
+            return fmatch()
         if name == "project":
             keys = args[0]
             bys = [b[0] for b in mods.get("by", [])]
