@@ -211,9 +211,19 @@ def _frontier_run(snap_or_graph, val, val_old, kind: str, wparams,
     cap_n = _next_pow2(max(n, 2))
     push = _push_slice(kind)
     wrapplan = _wrap_plan(kind)
-    budget = SLICE_BUDGET_CHUNKS
     max_dc = _max_degc(g)
-    p_full = _next_pow2(max(budget + max_dc, 2))
+    # a slice carries up to budget + max_dc chunks (one vertex of
+    # overshoot), so budget == 2^k would push p_cap to 2^(k+1) and HALF
+    # of every big slice's lanes would be padding — shave max_dc off the
+    # budget instead so full slices fit a 2^k kernel exactly (measured
+    # 2026-07-31: scale-26 SSSP round cost is dominated by these lanes)
+    target = _next_pow2(max(SLICE_BUDGET_CHUNKS, 2))
+    if max_dc <= target // 2:
+        budget = target - max_dc
+        p_full = target
+    else:                       # degenerate hub: conservative old scheme
+        budget = SLICE_BUDGET_CHUNKS
+        p_full = _next_pow2(max(budget + max_dc, 2))
 
     wp = jnp.asarray(np.asarray(wparams, np.float32))
     rounds = 0
