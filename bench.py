@@ -5,17 +5,18 @@ The harness parses the LAST stdout line, so a timeout costs only the
 stages not yet reached — never the ones already measured (round-2
 post-mortem: a single final print + a 27-minute compile stall recorded
 nothing). Stages run cheapest-first and a wall-clock budget
-(``BENCH_BUDGET_S``, default 1500 s) skips stages that no longer fit,
+(``BENCH_BUDGET_S``, default 2400 s) skips stages that no longer fit,
 noting them in ``detail.skipped``.
 
-Stage order (cheap → expensive):
+Stage order (cheap → expensive; ssspwcc right after bfs26 so the ~10GB
+scale-26 device graph uploads once):
   1. gods_2hop       — GraphOfTheGods 2-hop Gremlin count, inmemory OLTP
   2. ldbc_is3_4hop   — LDBC-SNB-style 4-hop friends expansion p50, sqlite
   3. bfs scale-23    — Graph500 BFS TEPS, single-/multi-chip
   4. bfs scale-26    — the headline (BASELINE.md row 1: >=1B on v5e-8,
                        125M/chip share)
-  5. pagerank s22    — LiveJournal-class s/iteration (>=50x-vs-MR row)
-  6. sssp/wcc        — Graph500 scale-26 SSSP + WCC seconds
+  5. sssp/wcc        — Graph500 scale-26 SSSP + WCC seconds
+  6. pagerank s22    — LiveJournal-class s/iteration (>=50x-vs-MR row)
 
 TEPS follows the official Graph500 definition: input edge tuples (incl.
 duplicates/self-loops) with both endpoints in the traversed component /
