@@ -199,3 +199,14 @@ def test_bearer_token_auth():
     finally:
         srv.stop()
         g.close()
+
+
+def test_script_endpoint_anonymous_traversals(server):
+    """Scripts can use the __ / anon helper for sub-traversal bodies
+    (union, repeat, match ...), like the Gremlin console."""
+    status, out = _post(server, "/traversal", {
+        "gremlin": "g.V().has('name', 'hercules')"
+                   ".union(__.out('father'), __.out('mother'))"
+                   ".values('name')"})
+    assert status == 200
+    assert sorted(out["result"]) == ["alcmene", "jupiter"]

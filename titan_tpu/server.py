@@ -105,11 +105,15 @@ class GraphServer:
         commits on success, rolls back on error (Gremlin Server's
         per-request transaction semantics)."""
         from titan_tpu.query.predicates import P
+        from titan_tpu.traversal import dsl as _dsl
         bindings = {"g": self.graph.traversal(), "graph": self.graph,
-                    "P": P, "__builtins__": {"len": len, "list": list,
-                                             "range": range, "sorted": sorted,
-                                             "min": min, "max": max,
-                                             "sum": sum}}
+                    "P": P, "anon": _dsl.anon,
+                    # TP3 __ helper for union/coalesce/repeat/match bodies
+                    "__": getattr(_dsl, "__"),
+                    "__builtins__": {"len": len, "list": list,
+                                     "range": range, "sorted": sorted,
+                                     "min": min, "max": max,
+                                     "sum": sum}}
         try:
             result = eval(script, bindings)  # noqa: S307 — script endpoint
             from titan_tpu.traversal.dsl import Traversal
@@ -245,14 +249,17 @@ def console(config) -> None:
 
     import titan_tpu
     from titan_tpu.query.predicates import P
+    from titan_tpu.traversal import dsl as _dsl
     graph = titan_tpu.open(config)
     banner = (f"titan_tpu console — graph open on "
               f"{graph.backend.manager.name}\n"
-              f"bindings: graph, g (traversal), P (predicates), mgmt")
+              f"bindings: graph, g (traversal), P (predicates), mgmt, "
+              f"__/anon (sub-traversals)")
     try:
         code.interact(banner=banner, local={
             "graph": graph, "g": graph.traversal(), "P": P,
-            "mgmt": graph.management()})
+            "mgmt": graph.management(), "anon": _dsl.anon,
+            "__": getattr(_dsl, "__")})
     finally:
         graph.close()
 

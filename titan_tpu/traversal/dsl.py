@@ -125,6 +125,20 @@ def anon() -> "Traversal":
     return Traversal(None)
 
 
+class _AnonBuilder:
+    """TP3's ``__`` spelling: ``__.out("x")`` starts a fresh anonymous
+    traversal (``__`` in TP3 is a static-method namespace, not a
+    callable)."""
+
+    def __getattr__(self, name):
+        def start(*args, **kwargs):
+            return getattr(anon(), name)(*args, **kwargs)
+        return start
+
+
+__ = _AnonBuilder()
+
+
 def conditions_to_query(q, conditions):
     """Translate folded has-conditions onto a GraphQuery. Returns the id
     filter set (or None), or raises _Unsupported when a condition can't be
