@@ -405,3 +405,20 @@ def test_match_disconnected_raises(gods):
 def test_match_without_start_as_raises(gods):
     with pytest.raises(ValueError, match="as_"):
         gods.traversal().V().match(anon().out("brother")).to_list()
+
+
+def test_match_mid_pattern_rebinding_enforces_join(gods):
+    """Review regression: an as_() MID-pattern that rebinds a shared
+    variable must enforce the join (zero rows), not silently overwrite."""
+    rows = gods.traversal().V().has("name", "hercules").match(
+        anon().as_("a").out("mother").as_("b"),
+        anon().as_("a").out("father").as_("b"),
+    ).to_list()
+    assert rows == []      # mother (alcmene) != father (jupiter)
+    # consistent double-binding DOES join
+    rows = gods.traversal().V().has("name", "hercules").match(
+        anon().as_("a").out("father").as_("b"),
+        anon().as_("b").out("father").as_("gf"),
+        anon().as_("a").out("father").as_("b"),   # duplicate, consistent
+    ).select("gf").by("name").to_list()
+    assert rows == ["saturn"]

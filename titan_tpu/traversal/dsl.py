@@ -724,36 +724,51 @@ class Traversal:
             return type(a) is type(b) and a.id == b.id
         return a == b
 
-    def _match_solve(self, tx, bindings: dict, patterns: list
-                     ) -> Iterator[dict]:
-        """Backtracking pattern join (TP3 MatchStep, conjunctive subset):
-        pick a pattern whose start variable is bound, enumerate its
-        solutions, extend/check bindings, recurse on the rest."""
-        if not patterns:
-            yield bindings
-            return
-        for k, pat in enumerate(patterns):
-            if pat._steps[0][1][0] in bindings:
-                chosen, rest = pat, patterns[:k] + patterns[k + 1:]
-                break
-        else:
-            names = [p._steps[0][1][0] for p in patterns]
-            raise ValueError(
-                f"match(): none of the remaining patterns {names} starts "
-                "at a bound variable (patterns must be connected)")
-        start = chosen._steps[0][1][0]
-        body = chosen._steps[1:]
+    @staticmethod
+    def _compile_pattern(pat: "Traversal") -> tuple:
+        """(start_var, body_sub, end_var) — built ONCE per pattern so
+        _apply_sub's normalization cache actually hits on re-entry."""
+        start = pat._steps[0][1][0]
+        body = pat._steps[1:]
         end_var = None
         if body and body[-1][0] == "as":
             end_var = body[-1][1][0]
             body = body[:-1]
         sub = Traversal(None)
         sub._steps = list(body)
-        sub._path_needed = chosen._path_needed
+        sub._path_needed = pat._path_needed
+        return start, sub, end_var
+
+    def _match_solve(self, tx, bindings: dict, patterns: list
+                     ) -> Iterator[dict]:
+        """Backtracking pattern join (TP3 MatchStep, conjunctive subset):
+        pick a pattern whose start variable is bound, enumerate its
+        solutions, extend/check bindings, recurse on the rest.
+        ``patterns``: list of _compile_pattern tuples."""
+        if not patterns:
+            yield bindings
+            return
+        for k, (start, _, _) in enumerate(patterns):
+            if start in bindings:
+                chosen, rest = patterns[k], patterns[:k] + patterns[k + 1:]
+                break
+        else:
+            names = [p[0] for p in patterns]
+            raise ValueError(
+                f"match(): none of the remaining patterns {names} starts "
+                "at a bound variable (patterns must be connected)")
+        start, sub, end_var = chosen
         seed = Traverser(bindings[start], labels=dict(bindings))
         for r in self._apply_sub(tx, iter([seed]), sub):
+            # join constraint for EVERY shared variable, including those
+            # an as_() mid-body rebound (overwrite would silently break
+            # the join semantics the docstring promises)
+            if any(k2 in bindings and
+                   not self._binding_eq(bindings[k2], v2)
+                   for k2, v2 in r.labels.items()):
+                continue
             newb = dict(bindings)
-            newb.update(r.labels)      # as_ bindings made inside the body
+            newb.update(r.labels)
             if end_var is not None:
                 if end_var in bindings and \
                         not self._binding_eq(bindings[end_var], r.obj):
@@ -1105,13 +1120,15 @@ class Traversal:
                     raise ValueError(
                         "match() patterns must start with as_(<var>)")
 
+            compiled = [self._compile_pattern(p) for p in patterns]
+
             def fmatch(ts=traversers):
-                start0 = patterns[0]._steps[0][1][0]
+                start0 = compiled[0][0]
                 for t in ts:
                     bindings0 = dict(t.labels)
                     bindings0[start0] = t.obj
                     for b in self._match_solve(tx, bindings0,
-                                               list(patterns)):
+                                               list(compiled)):
                         nt = t.extend(b)
                         nt.labels = b    # select() projects variables
                         yield nt
