@@ -422,3 +422,26 @@ def test_match_mid_pattern_rebinding_enforces_join(gods):
         anon().as_("a").out("father").as_("b"),   # duplicate, consistent
     ).select("gf").by("name").to_list()
     assert rows == ["saturn"]
+
+
+def test_limit_keeps_vertex_step_lazy(social):
+    """ADVICE r3: the bulking barrier is chunked (TP3 NoOpBarrier(2500)
+    semantics) — g.V().out().limit(1) must not expand the entire
+    frontier's adjacency before limit() can short-circuit."""
+    g = social
+    calls = []
+    tx_cls = type(g.new_transaction())
+    real = tx_cls.multi_vertex_edges
+
+    def counting(self, vids, *a, **kw):
+        calls.append(len(vids))
+        return real(self, vids, *a, **kw)
+
+    tx_cls.multi_vertex_edges = counting
+    try:
+        got = g.traversal().V().out("knows").out("knows").limit(1).to_list()
+    finally:
+        tx_cls.multi_vertex_edges = real
+    assert len(got) == 1
+    # lazy: far fewer sources expanded than the full two-hop frontier
+    assert sum(calls) <= 2 * 512 + 2

@@ -164,3 +164,14 @@ def test_worker_bearer_token_gate():
         assert json_call(w.url, "/ping", {}, token="s3cret") == {"ok": True}
     finally:
         w.stop()
+
+
+def test_factory_allowlist_is_dot_anchored():
+    """ADVICE r3: entry 'myjobs' must not admit sibling 'myjobs_evil'."""
+    w = ScanWorkerServer(factory_allow=["myjobs", "titan_tpu."])
+    assert w._factory_allowed("myjobs:job")
+    assert w._factory_allowed("myjobs.sub:job")
+    assert w._factory_allowed("titan_tpu.olap.jobs:GhostVertexRemover")
+    assert not w._factory_allowed("myjobs_evil:job")
+    assert not w._factory_allowed("titan_tpu_evil.mod:job")
+    assert not w._factory_allowed("os:system")

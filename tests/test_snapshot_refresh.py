@@ -192,3 +192,47 @@ def test_dropped_snapshot_unregisters_listener(graph):
     del snap
     gc.collect()
     assert len(graph._change_listeners) == n0
+
+
+def test_refresh_leaves_future_payloads_queued(graph):
+    """ADVICE r3: a payload racing past the new_epoch refresh() read must
+    stay queued for the NEXT refresh — draining it early and stamping
+    self.epoch = new_epoch made the next continuity check see a hole and
+    force a spurious rebuild."""
+    snap = snap_mod.build(graph)
+    tx = graph.new_transaction()
+    vs = list(tx.vertices())
+    vs[0].add_edge("link", vs[1])
+    tx.commit()                                   # epoch 1 payload queued
+    future = {"epoch": graph.mutation_epoch + 1, "added": [], "removed": [],
+              "added_vertices": [], "removed_vertices": []}
+    snap._listener.append(future)                 # racing commit's payload
+    snap.refresh()
+    assert snap.epoch == graph.mutation_epoch
+    assert list(snap._listener) == [future]       # not drained, not applied
+
+
+def test_build_retries_when_commit_races_scan(graph, monkeypatch):
+    """build() must detect an epoch bump during its store scan and rescan
+    (the racing commit may or may not be in the scanned rows)."""
+    real_scan = snap_mod._scan_python
+    calls = {"n": 0}
+
+    def racing_scan(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:     # commit lands mid-scan, first attempt only
+            tx = graph.new_transaction()
+            vs = list(tx.vertices())
+            vs[0].add_edge("link", vs[4])
+            tx.commit()
+        return real_scan(*a, **kw)
+
+    monkeypatch.setattr(snap_mod, "_scan_python", racing_scan)
+    monkeypatch.setattr(snap_mod.native, "available", False)
+    snap = snap_mod.build(graph)
+    assert calls["n"] == 2                         # retried once
+    assert snap.epoch == graph.mutation_epoch
+    assert not snap.stale
+    # the racing edge is in the snapshot exactly once
+    assert _edge_id_pairs(snap).count(
+        (int(snap.vertex_ids[0]), int(snap.vertex_ids[4]))) == 1

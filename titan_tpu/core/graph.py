@@ -19,7 +19,7 @@ from titan_tpu.config import (Configuration, MapConfiguration, defaults as d)
 from titan_tpu.core.defs import Direction, RelationCategory
 from titan_tpu.core.schema import SchemaManager
 from titan_tpu.core.tx import GraphTransaction
-from titan_tpu.errors import TitanError
+from titan_tpu.errors import ConfigurationError, TitanError
 from titan_tpu.ids import IDManager
 from titan_tpu.ids.assigner import IDAssigner
 from titan_tpu.storage.api import Entry
@@ -94,8 +94,22 @@ class StandardGraph:
         self._open = True
         self._tlocal = threading.local()
         self._index_providers: dict = {}   # name -> IndexProvider
-        for name in config.container_names(d.INDEX_NS):
-            self._open_index_provider(name)
+        try:
+            for name in config.container_names(d.INDEX_NS):
+                self._open_index_provider(name)
+        except ConfigurationError:
+            # a raising open must not leak the already-opened storage
+            # backend or leave a ghost entry in the instance registry
+            try:
+                self.backend.instance_registry.deregister(self.instance_id)
+            except Exception:   # noqa: BLE001 — best-effort cleanup
+                pass
+            try:
+                self.backend.close()
+            except Exception:   # noqa: BLE001
+                pass
+            self._open = False
+            raise
         self._commit_lock = threading.Lock()
         self._metrics = None
         self._metrics_prefix = config.get(d.METRICS_PREFIX) or "titan_tpu"
@@ -120,9 +134,20 @@ class StandardGraph:
             provider = RemoteIndexProvider(
                 name, hostname=hosts[0] if hosts else "127.0.0.1",
                 port=self.config.get(d.INDEX_PORT, name) or 8284)
-        elif backend in ("memindex", "elasticsearch", "solr"):
-            # in-process provider; real cluster providers plug in via
-            # import path
+        elif backend in ("elasticsearch", "solr"):
+            # honesty over convenience: these names promise a CLUSTER
+            # index (reference: StandardIndexProvider maps them to real
+            # providers) — silently handing back the in-process
+            # MemoryIndex would give a user a non-durable per-process
+            # index while they believe they attached a cluster
+            raise ConfigurationError(
+                f"index.{name}.backend={backend!r} names a cluster index "
+                "this build does not embed; use backend=remote-index "
+                "pointing at a `python -m titan_tpu.indexing.remote` "
+                "node (the ES/Solr-role networked provider), "
+                "backend=lucene for the embedded full-text engine, or "
+                "backend=memindex for an explicit in-process index")
+        elif backend == "memindex":
             from titan_tpu.indexing.memindex import MemoryIndex
             provider = MemoryIndex(name, directory or None)
         else:
@@ -140,6 +165,8 @@ class StandardGraph:
         if p is None and name:
             try:
                 p = self._open_index_provider(name)
+            except ConfigurationError:
+                raise          # misconfiguration must not degrade to None
             except Exception:
                 return None
         return p
@@ -383,14 +410,20 @@ class StandardGraph:
                 except BaseException:
                     btx.rollback()
                     raise
-            if wal is not None:
-                wal.log_primary_success(txid)
-            # storage is durable: feed subscribed snapshots their delta,
-            # THEN bump the epoch (under the commit lock, so payload
-            # epochs are gap-free and a concurrent refresh() that reads
-            # the new epoch is guaranteed to find the payload already
-            # queued — see snapshot.refresh's continuity check)
-            with self._commit_lock:
+                # WAL primary-success IMMEDIATELY after the storage
+                # commit: a crash while building/pushing change payloads
+                # below must not leave a durable commit classified by
+                # TransactionRecovery as "failed before storage commit"
+                if wal is not None:
+                    wal.log_primary_success(txid)
+                # storage is durable: feed subscribed snapshots their
+                # delta, THEN bump the epoch — in the SAME lock block as
+                # commit_storage, so storage visibility and epoch order
+                # are atomic. (If the lock were dropped between the two,
+                # a snapshot build() scanning in the gap would see the
+                # edge in storage AND later receive its payload with an
+                # epoch > epoch0, double-applying it through refresh()'s
+                # continuity check.)
                 epoch_next = self._mutation_epoch + 1
                 listeners = list(self._change_listeners.values())
                 if listeners:
@@ -444,12 +477,17 @@ class StandardGraph:
         the returned queue. The registry holds it WEAKLY — keep a strong
         reference (snapshots do) or it auto-unregisters. Used by OLAP
         snapshots for delta refresh."""
-        from titan_tpu.core.changes import ChangeQueue
         with self._commit_lock:
-            self._listener_seq += 1
-            token = self._listener_seq
-            q = ChangeQueue()
-            self._change_listeners[token] = q
+            return self._subscribe_locked()
+
+    def _subscribe_locked(self) -> tuple[int, "ChangeQueue"]:
+        """Register a listener; caller must hold ``_commit_lock`` (lets
+        snapshot.build() atomically check the epoch and subscribe)."""
+        from titan_tpu.core.changes import ChangeQueue
+        self._listener_seq += 1
+        token = self._listener_seq
+        q = ChangeQueue()
+        self._change_listeners[token] = q
         return token, q
 
     def unsubscribe_changes(self, token: int) -> None:

@@ -14,6 +14,11 @@ class TitanError(Exception):
     """Root of all titan_tpu errors."""
 
 
+class ConfigurationError(TitanError):
+    """Invalid or unsupported configuration (bad backend name, option
+    value out of range, mutually exclusive settings)."""
+
+
 # ---------------------------------------------------------------------------
 # storage plane
 # ---------------------------------------------------------------------------

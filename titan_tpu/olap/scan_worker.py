@@ -64,9 +64,11 @@ class ScanWorkerServer(JsonNode):
         self.factory_allow = list(factory_allow)
 
     def _factory_allowed(self, factory: str) -> bool:
+        # dot-anchored only: an allowlist entry "myjobs" must not also
+        # admit sibling modules like "myjobs_evil"
         mod = factory.split(":", 1)[0]
-        return any(mod == p.rstrip(".") or mod.startswith(p) or
-                   (not p.endswith(".") and mod.startswith(p + "."))
+        return any(mod == p.rstrip(".")
+                   or mod.startswith(p.rstrip(".") + ".")
                    for p in self.factory_allow)
 
     def _dispatch(self, path: str, req: dict):

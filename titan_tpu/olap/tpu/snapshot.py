@@ -102,10 +102,15 @@ class GraphSnapshot:
                 "snapshot")
         new_epoch = g.mutation_epoch
         pending: list = []
-        while q:                 # pop-drain: a commit that bumped the
-            pending.append(q.pop(0))   # epoch we read has ALREADY queued
-        #                              # its payload (commit pushes before
-        #                              # bumping, under the commit lock)
+        # pop-drain UP TO new_epoch only: a commit that bumped the epoch
+        # we read has already queued its payload (push precedes bump,
+        # under the commit lock), but a commit racing THIS refresh may
+        # queue payloads with epoch > new_epoch — those must stay queued
+        # for the next refresh, or its continuity check would find a
+        # hole and force a spurious rebuild
+        while q and (q[0].get("epoch") is None
+                     or q[0]["epoch"] <= new_epoch):
+            pending.append(q.pop(0))
         # continuity: the payloads must cover exactly
         # (self.epoch, new_epoch] — a gap means commits this listener
         # never saw (e.g. they landed during build()'s store scan), and
@@ -462,11 +467,6 @@ def build(graph, labels: Optional[Sequence[str]] = None,
     idm = graph.idm
     schema = graph.schema
     codec = graph.codec
-    # epoch is captured BEFORE the scan: a commit racing the scan bumps
-    # past it, flipping `stale` true; since the listener (subscribed
-    # after the scan) missed that payload, refresh()'s continuity check
-    # fails loud and demands a rebuild instead of silently corrupting
-    epoch0 = graph.mutation_epoch
     label_ids = None
     if labels is not None:
         label_ids = {st.id for name in labels
@@ -481,20 +481,48 @@ def build(graph, labels: Optional[Sequence[str]] = None,
                                   include_system=False)
     scan_q = SliceQuery(lo, hi)
 
-    btx = graph.backend.begin_transaction()
-    try:
-        exists_q = codec.query_type(schema.system.vertex_exists, Direction.OUT,
-                                    schema)[0]
-        rows = graph.backend.edge_store.store.get_keys(SliceQuery(),
-                                                       btx.store_tx)
-        if native.available and not key_ids:
-            vertex_id_list, srcs, dsts, labs, ev = _scan_native(
-                graph, rows, exists_q, label_ids)
-        else:
-            vertex_id_list, srcs, dsts, labs, ev = _scan_python(
-                graph, rows, exists_q, scan_q, label_ids, key_ids)
-    finally:
-        btx.commit()
+    # Epoch discipline: capture epoch0, scan, then — under the commit
+    # lock — verify the epoch did not move during the scan and subscribe
+    # atomically. A commit that lands mid-scan may or may not be in the
+    # scanned rows (the scan has no store-level snapshot isolation), so
+    # its delta payload can't be safely applied OR skipped; retry the
+    # scan, and fail loud if writers keep racing. Commits push payload +
+    # bump epoch atomically with commit_storage (core/graph.py commit),
+    # so an unchanged epoch proves the scan saw a committed prefix.
+    import contextlib
+
+    def _scan_once():
+        btx = graph.backend.begin_transaction()
+        try:
+            exists_q = codec.query_type(schema.system.vertex_exists,
+                                        Direction.OUT, schema)[0]
+            rows = graph.backend.edge_store.store.get_keys(SliceQuery(),
+                                                           btx.store_tx)
+            if native.available and not key_ids:
+                return _scan_native(graph, rows, exists_q, label_ids)
+            return _scan_python(graph, rows, exists_q, scan_q, label_ids,
+                                key_ids)
+        finally:
+            btx.commit()
+
+    token = q = None
+    for attempt in range(3):
+        # final attempt scans while HOLDING the commit lock: writers are
+        # excluded for one scan, so build() terminates under any write
+        # load instead of spinning forever on epoch bumps
+        hold = graph._commit_lock if attempt == 2 else \
+            contextlib.nullcontext()
+        with hold:
+            epoch0 = graph.mutation_epoch
+            vertex_id_list, srcs, dsts, labs, ev = _scan_once()
+            if attempt == 2:
+                token, q = graph._subscribe_locked()
+                break
+        with graph._commit_lock:
+            if graph.mutation_epoch == epoch0:
+                token, q = graph._subscribe_locked()
+                break
+    assert token is not None
 
     vertex_ids = np.array(sorted(vertex_id_list), dtype=np.int64)
     n = len(vertex_ids)
@@ -523,11 +551,11 @@ def build(graph, labels: Optional[Sequence[str]] = None,
         if st is not None:
             label_names[code] = st.name
     snap = from_arrays(n, src, dst, vertex_ids, evs, labs_arr, label_names)
-    # freshness contract: stamp the pre-scan epoch and subscribe for
-    # deltas so refresh() can catch this snapshot up without a store
-    # re-scan (see epoch0 note above for the race semantics)
+    # freshness contract: stamp the scan-verified epoch and attach the
+    # listener subscribed atomically with the epoch check above, so
+    # refresh() can catch this snapshot up without a store re-scan
     snap.epoch = epoch0
     snap._graph = graph
-    snap._listener_token, snap._listener = graph.subscribe_changes()
+    snap._listener_token, snap._listener = token, q
     snap._build_params = {"label_ids": label_ids, "directed": directed}
     return snap

@@ -172,6 +172,9 @@ class _Unsupported(Exception):
 _MODULATORS = frozenset({"by", "option", "times", "until", "emit"})
 # steps after which the bulk barrier runs (the explosive ones)
 _BARRIER_AFTER = frozenset({"vstep", "edgevertex"})
+# bulking-barrier chunk: TP3's LazyBarrierStrategy uses NoOpBarrierStep
+# with maxBarrierSize=2500 precisely to bound the laziness loss
+_BARRIER_CHUNK = 2500
 # bulk-aware aggregates: a barrier right before them is wasted work
 _BULK_AGGREGATES = frozenset({"count", "sum", "mean", "groupCount",
                               "group"})
@@ -664,23 +667,31 @@ class Traversal:
 
     @classmethod
     def _barrier(cls, traversers) -> Iterator[Traverser]:
-        """LazyBarrierStrategy analog: drain the stream, merge traversers
-        with equal location into one with summed bulk."""
+        """LazyBarrierStrategy analog: merge traversers with equal
+        location into one with summed bulk — within bounded chunks of
+        ``_BARRIER_CHUNK`` (TP3 inserts ``NoOpBarrierStep(2500)``, not an
+        unbounded drain), so ``g.V().out().limit(1)`` stays lazy instead
+        of expanding the whole frontier before limit() can cut it."""
         def gen():
-            merged: dict = {}
-            extras: list = []
-            for t in traversers:
-                k = cls._merge_key(t)
-                if k is None:
-                    extras.append(t)
-                    continue
-                cur = merged.get(k)
-                if cur is None:
-                    merged[k] = t
-                else:
-                    cur.bulk += t.bulk
-            yield from merged.values()
-            yield from extras
+            it = iter(traversers)
+            while True:
+                batch = list(itertools.islice(it, _BARRIER_CHUNK))
+                if not batch:
+                    return
+                merged: dict = {}
+                extras: list = []
+                for t in batch:
+                    k = cls._merge_key(t)
+                    if k is None:
+                        extras.append(t)
+                        continue
+                    cur = merged.get(k)
+                    if cur is None:
+                        merged[k] = t
+                    else:
+                        cur.bulk += t.bulk
+                yield from merged.values()
+                yield from extras
         return gen()
 
     # -- sub-traversal helpers ----------------------------------------------

@@ -8,6 +8,7 @@ TemporaryBackendError (retryable by the backend-op layer); anything else →
 
 from __future__ import annotations
 
+import hmac
 import json
 import os
 import threading
@@ -58,9 +59,16 @@ class JsonNode:
                 self.wfile.write(body)
 
             def do_POST(self):
-                if node.auth_token is not None and \
-                        self.headers.get("Authorization") != \
-                        f"Bearer {node.auth_token}":
+                # constant-time compare: this is the mesh-auth boundary,
+                # a plain != leaks token prefixes through timing. Bytes,
+                # not str: compare_digest raises on non-ASCII str input
+                # (http.server decodes headers latin-1), and a malformed
+                # header must 401, not crash the handler
+                if node.auth_token is not None and not hmac.compare_digest(
+                        (self.headers.get("Authorization") or "").encode(
+                            "utf-8", "surrogateescape"),
+                        f"Bearer {node.auth_token}".encode(
+                            "utf-8", "surrogateescape")):
                     self._send(401, {"error": "missing or bad bearer token"})
                     return
                 length = int(self.headers.get("Content-Length", 0))
