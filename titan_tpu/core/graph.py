@@ -97,8 +97,9 @@ class StandardGraph:
         try:
             for name in config.container_names(d.INDEX_NS):
                 self._open_index_provider(name)
-        except ConfigurationError:
-            # a raising open must not leak the already-opened storage
+        except Exception:
+            # ANY raising provider open (ConfigurationError, a bad
+            # import path, ...) must not leak the already-opened storage
             # backend or leave a ghost entry in the instance registry
             try:
                 self.backend.instance_registry.deregister(self.instance_id)

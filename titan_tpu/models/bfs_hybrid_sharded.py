@@ -49,6 +49,10 @@ BU_FUSE = 4
 # instrumentation: found_cap used by each level's exchange in the most
 # recent run (tests assert the exchange stays sparse)
 LAST_EXCHANGE_CAPS: list = []
+# full per-level communication profile of the most recent run: mode,
+# frontier size, per-chip found max, exchange cap/volume, retries
+# (MULTICHIP_r04 evidence — the dryrun prints it)
+LAST_PROFILE: list = []
 
 
 def plan_shard_cuts(colstart: np.ndarray, n: int, num_shards: int):
@@ -137,6 +141,11 @@ def shard_chunked_csr(snap_or_graph, num_shards: int):
         "b_max": b_max, "q_max": q_max, "q_total": q_total,
         "degc": np.concatenate([degc_all, [0]]).astype(np.int32),
         "total_chunks": total,
+        # per-shard chunk spans — the edge-balance evidence the comm
+        # profile reports (cuts are planned on the chunk prefix, so
+        # these should be near-uniform)
+        "shard_chunks": [int(colstart[bounds[d + 1]] - colstart[bounds[d]])
+                         for d in range(d_eff)],
     }
     if isinstance(g, dict):
         g["_shards"] = (num_shards, out)
@@ -169,18 +178,14 @@ def _td_expand():
                     valid, degc_l[v], cs_l[v], p_cap,
                     dstT_l.shape[1] - 1)
                 nbr = jnp.take(dstT_l, cols, axis=1)
-                newd = dist.at[nbr].min(level + 1, mode="drop")
-                newly = (newd[:n_] == level + 1) & (dist[:n_] > level + 1)
-                cnt = newly.sum().astype(jnp.int32)
-                counts = jax.lax.all_gather(cnt, VERTEX_AXIS)
-                return newd[None], counts
+                return dist.at[nbr].min(level + 1, mode="drop")[None]
 
             return jax.shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(P(), P(), P(VERTEX_AXIS, None, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS), P(VERTEX_AXIS)),
-                out_specs=(P(VERTEX_AXIS, None), P()),
+                out_specs=P(VERTEX_AXIS, None),
                 check_vma=False,
             )(dist, frontier, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
         return td
@@ -199,11 +204,15 @@ def _exchange():
             jax.jit, static_argnames=("mesh", "found_cap", "n_"))
         def ex(dist_sh, level, degc, mesh, found_cap: int, n_: int):
             """Merge per-chip discoveries: all-gather each chip's newly-
-            found ids (found_cap = host-sized max) and apply to every
-            replica; returns merged dist (replicated) + stats + the new
-            frontier list."""
+            found ids and apply to every replica; returns merged dist
+            (replicated) + stats + the new frontier list. ``found_cap``
+            is DEVICE-CHECKED: stats carry the true per-chip found max,
+            and the host retries with a bigger cap on overflow (the
+            merged result is then discarded) — no pre-sizing readback."""
             def per_shard(dist, degc):
                 newly = dist[0][:n_] == level + 1
+                cnt = newly.sum().astype(jnp.int32)
+                found_max = jax.lax.pmax(cnt, VERTEX_AXIS)
                 ids = jnp.nonzero(newly, size=found_cap,
                                   fill_value=n_ + 1)[0].astype(jnp.int32)
                 all_ids = jax.lax.all_gather(ids, VERTEX_AXIS)  # [D, cap]
@@ -218,7 +227,8 @@ def _exchange():
                 unvis = merged[:n_] >= INF
                 m8_unvis = jnp.where(unvis, degc[:n_], 0) \
                     .sum(dtype=jnp.int32)
-                return merged, frontier, jnp.stack([nf, m8_f, m8_unvis])
+                return merged, frontier, jnp.stack(
+                    [nf, m8_f, m8_unvis, found_max])
 
             return jax.shard_map(
                 per_shard, mesh=mesh,
@@ -307,16 +317,14 @@ def _bu_level():
                 gv = jnp.where(found, lv + lo, n_ + 1)
                 dist = dist.at[gv].set(level + 1, mode="drop")
 
-                cnt = (dist[:n_] == level + 1).sum().astype(jnp.int32)
-                counts = jax.lax.all_gather(cnt, VERTEX_AXIS)
-                return dist[None], counts
+                return dist[None]
 
             return jax.shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(P(), P(VERTEX_AXIS, None, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS), P(VERTEX_AXIS)),
-                out_specs=(P(VERTEX_AXIS, None), P()), check_vma=False,
+                out_specs=P(VERTEX_AXIS, None), check_vma=False,
             )(dist, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
         return bu
     return jit_once("shbfs_bu", build)
@@ -366,7 +374,10 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
     m8_f = int(np.asarray(degc[source_dense]))
     m8_unvis = total_chunks - m8_f
     level = 0
+    found_guess = 4
     LAST_EXCHANGE_CAPS.clear()
+    LAST_PROFILE.clear()
+    num_dev = int(mesh.devices.size)
     while f_count > 0 and level < max_levels:
         use_bu = m8_f * ALPHA > m8_unvis and f_count > 1
         if not use_bu:
@@ -377,24 +388,42 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
             # chunk total is a safe upper bound for every shard
             p_cap = min(_next_pow2(max(m8_f, 2)),
                         _next_pow2(max(total_chunks + n, 2)))
-            dist, counts = td(dist, frontier[:f_cap], jnp.int32(f_count),
-                              jnp.int32(level), dstT_sh, colstart_sh,
-                              degc_sh, lo_sh, hi_sh, mesh=mesh,
-                              f_cap=f_cap, p_cap=p_cap, n_=n, b_max=b_max)
+            dist_sh = td(dist, frontier[:f_cap], jnp.int32(f_count),
+                         jnp.int32(level), dstT_sh, colstart_sh,
+                         degc_sh, lo_sh, hi_sh, mesh=mesh,
+                         f_cap=f_cap, p_cap=p_cap, n_=n, b_max=b_max)
         else:
             c_cap = _next_pow2(max(b_max, 2))
             p_cap = _next_pow2(max(sh["q_max"], 2))
-            dist, counts = bu(dist, jnp.int32(level), dstT_sh,
-                              colstart_sh, degc_sh, lo_sh, hi_sh,
-                              mesh=mesh, c_cap=c_cap, p_cap=p_cap, n_=n,
-                              b_max=b_max, rounds=BU_CHUNK_ROUNDS)
-        found_max = int(np.asarray(counts).max())
-        found_cap = _next_pow2(max(found_max, 2))
-        LAST_EXCHANGE_CAPS.append(found_cap)
-        dist, frontier, st = ex(dist, jnp.int32(level), degc, mesh=mesh,
-                                found_cap=found_cap, n_=n)
+            dist_sh = bu(dist, jnp.int32(level), dstT_sh,
+                         colstart_sh, degc_sh, lo_sh, hi_sh,
+                         mesh=mesh, c_cap=c_cap, p_cap=p_cap, n_=n,
+                         b_max=b_max, rounds=BU_CHUNK_ROUNDS)
+        # device-sized exchange: dispatch with the adaptive guess cap and
+        # read ONE stats vector back (the only host sync of the level);
+        # the stats carry the true per-chip found max, so an overflowed
+        # merge is discarded and re-run with the exact cap (rare — the
+        # guess tracks 4x the previous level's max)
+        found_cap, retries = found_guess, 0
+        while True:
+            dist_m, frontier, st = ex(dist_sh, jnp.int32(level), degc,
+                                      mesh=mesh, found_cap=found_cap,
+                                      n_=n)
+            f_count, m8_f, m8_unvis, found_max = \
+                (int(x) for x in np.asarray(st))
+            if found_max <= found_cap:
+                break
+            found_cap = _next_pow2(max(found_max, 2))
+            retries += 1
+        dist = dist_m
         frontier = pad(frontier)
-        f_count, m8_f, m8_unvis = (int(x) for x in np.asarray(st))
+        LAST_EXCHANGE_CAPS.append(found_cap)
+        LAST_PROFILE.append({
+            "level": level, "mode": "bu" if use_bu else "td",
+            "nf": f_count, "m8_f": m8_f,
+            "found_max_per_chip": found_max, "found_cap": found_cap,
+            "exchanged_ids": num_dev * found_cap, "retries": retries})
+        found_guess = min(_next_pow2(max(4 * found_max, 4)), cap_n)
         level += 1
     out = dist[0, :n] if dist.ndim == 2 else dist[:n]
     if not return_device:

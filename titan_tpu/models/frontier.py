@@ -5,21 +5,34 @@ relaxations — the frontier-sparse analogs of the reference's OLAP
 fixtures (reference: titan-test olap/ShortestDistanceVertexProgram for
 SSSP, min-label propagation for connected components): instead of full
 edge sweeps every superstep (O(E x rounds), the FulgoraGraphComputer
-model), each round expands ONLY the vertices whose value changed in the
-previous round, which bounds total work by the relaxation count.
+model), each round expands ONLY the vertices whose value improved since
+their last EXPANSION — ``val_expanded`` records the value each vertex
+last pushed, so the frontier needs no per-round state copies and a round
+interrupted mid-way (slice-cap overflow) resumes exactly where it left
+off.
 
-* ``frontier_sssp`` — Bellman-Ford with an improvement frontier.
-  Edge weights are derived ON DEVICE by hashing the edge slot id
-  (uniform in [min_w, min_w+w_range)), so a scale-26 run needs no
-  second 9GB weight array; ``slot_weights_np`` reproduces them on the
-  host for verification.
-* ``frontier_wcc`` — min-label propagation with an active set; on the
-  symmetrized graph labels converge to per-component minima.
+* ``frontier_sssp`` — DELTA-STEPPING (Meyer & Sanders) over hashed edge
+  weights: vertices are expanded in distance buckets of width ``delta``
+  (one-sided: every improved vertex below the current bucket top is
+  eligible, so stragglers never accumulate), which re-examines each
+  vertex's edge list a small constant number of times instead of the
+  O(rounds) full re-relaxation a plain Bellman-Ford improvement
+  frontier pays on continuous weights. Weights are derived ON DEVICE by
+  hashing the edge slot id (uniform in [min_w, min_w+w_range)), so a
+  scale-26 run needs no second 9GB weight array; ``slot_weights_np``
+  reproduces them on the host for verification.
+* ``frontier_wcc`` — hybrid connected components: one
+  direction-optimized BFS (models/bfs_hybrid — the most optimized
+  kernel in the repo) peels off the seed vertex's ENTIRE component in
+  one shot (on power-law graphs that is ~all edge mass), then min-label
+  propagation runs only over the leftover components' tiny edge mass.
+  A component is a closed set — no edge crosses the peeled boundary —
+  so the two phases compose exactly.
 
-Both keep all state on device with one small stats readback per round
-(axon-tunnel D2H is ~0.01 GB/s; see PERF_NOTES.md) and share the
-chunked-CSR graph dict of ``bfs_hybrid`` (GraphSnapshot or
-``graph500.to_device`` output).
+All state stays on device with one small plan readback per round
+(axon-tunnel D2H is ~0.01 GB/s; see PERF_NOTES.md); the graph dict is
+``bfs_hybrid``'s chunked CSR (GraphSnapshot or ``graph500.to_device``
+output).
 """
 
 from __future__ import annotations
@@ -29,8 +42,9 @@ import functools
 import numpy as np
 
 from titan_tpu.models.bfs_hybrid import (build_chunked_csr,
-                                         enumerate_chunk_pairs)
-from titan_tpu.models.bfs import _next_pow2
+                                         enumerate_chunk_pairs,
+                                         frontier_bfs_hybrid)
+from titan_tpu.models.bfs import INF, _next_pow2
 from titan_tpu.utils.jitcache import jit_once
 
 FINF = np.float32(3.0e38)
@@ -64,12 +78,12 @@ def slot_weights_np(slots: np.ndarray, min_w: float = 0.0,
 # message + weight-hash temporaries, ~4 of them) at ~1GB — at scale 26
 # the graph itself holds 9GB of the 16GB HBM, and unbounded pair caps
 # OOMed. Rounds whose frontier mass exceeds the budget are processed as
-# multiple slices planned ON DEVICE (one boundary readback per round),
-# so total work tracks the ACTUAL relaxation mass — a dense all-slot
-# sweep at scale 26 paid 2.15B scatters per round regardless of activity
-# and took ~28s/round.
+# multiple slices planned ON DEVICE (one boundary readback per round).
+# A round with more mass than SLICE_K_MAX slices simply leaves the
+# overflow vertices improved-but-unexpanded; the next plan picks them
+# up — the expansion-tracked frontier makes partial rounds sound.
 SLICE_BUDGET_CHUNKS = 1 << 23
-SLICE_K_MAX = 128
+SLICE_K_MAX = 64
 # legacy dense-window machinery (kept for pagerank_dense, where every
 # vertex IS active every iteration and slot padding is the only waste)
 DENSE_WINDOW = 1 << 22
@@ -97,84 +111,100 @@ def _colowner(g):
 
 
 def _wrap_plan(kind: str):
-    """Round end, fused into ONE readback: the new frontier (vertices
-    whose value improved vs ``val_old``), the round's stats, and the
-    SLICE PLAN for the next round — frontier-index boundaries placed
-    every SLICE_BUDGET_CHUNKS of cumulative chunk mass (device
-    searchsorted), so the host sizes each slice's kernel without extra
-    syncs. A slice may exceed the budget by at most one vertex's chunks
-    (p_cap adds max_degc)."""
+    """Build the round plan in ONE readback — pure elementwise + scan
+    work (NO n-scale nonzero, NO random gathers: the round-1 design
+    gathered ``degc[frontier]`` at cap scale, ~1s/round at scale 26
+    against the 67M elem/s big-table regime, which dominated fine-delta
+    runs). The frontier is never materialized as a list: slices are
+    VERTEX RANGES whose in-bucket chunk mass is ~SLICE_BUDGET_CHUNKS
+    (one masked cumsum + k_max searchsorteds), and each push slice
+    recomputes the membership mask for its contiguous range."""
     def build():
         import jax
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
-                           static_argnames=("n_", "cap", "k_max",
-                                            "budget"))
-        def wrapplan(val, val_old, degc, fb0, n_: int, cap: int,
-                     k_max: int, budget: int):
-            changed = val[:n_] < val_old[:n_]
-            nf = changed.sum().astype(jnp.int32)
-            frontier = jnp.nonzero(
-                changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
-            if cap > n_:
-                frontier = jnp.concatenate(
-                    [frontier, jnp.full((cap - n_,), n_, jnp.int32)])
-            cdeg = jnp.where(jnp.arange(cap) < nf,
-                             degc[jnp.minimum(frontier, n_)], 0)
-            cum = jnp.cumsum(cdeg)
-            m8 = jnp.where(nf > 0, cum[jnp.maximum(nf - 1, 0)], 0)
-            # sequential boundaries with RELATIVE budgets (an absolute
-            # target schedule breaks after a forced single-hub slice) and
-            # a forced >=1-vertex advance so an over-budget hub cannot
-            # stall the plan
-            def body(i, bounds):
-                b = bounds[i]
-                base = jnp.where(b > 0, cum[jnp.maximum(b - 1, 0)], 0)
-                nxt = jnp.searchsorted(
-                    cum, base + budget, side="right").astype(jnp.int32)
-                nxt = jnp.minimum(jnp.maximum(nxt, b + 1), nf)
-                return bounds.at[i + 1].set(nxt)
-
-            bounds = jax.lax.fori_loop(
-                0, k_max, body,
-                jnp.zeros((k_max + 1,), jnp.int32).at[0].set(
-                    jnp.minimum(fb0, nf)))
-            widths = jnp.diff(bounds)
-            plan = jnp.concatenate(
-                [jnp.stack([nf, m8, widths.max()]), bounds])
-            return frontier, plan
+                           static_argnames=("n_", "k_max", "budget"))
+        def wrapplan(val, val_exp, degc, bucket_end, n_: int, k_max: int,
+                     budget: int):
+            hasdeg = degc[:n_] > 0
+            changed = (val[:n_] < val_exp[:n_]) & hasdeg
+            inb = changed & (val[:n_] < bucket_end)
+            nf = inb.sum().astype(jnp.int32)
+            cummass = jnp.cumsum(
+                jnp.where(inb, degc[:n_], 0), dtype=jnp.int32)
+            m8 = cummass[-1]
+            # vertex-space boundaries on an ABSOLUTE mass schedule —
+            # one BATCHED searchsorted (a sequential fori of dependent
+            # searchsorteds measured ~0.8s/plan at scale 26; this is the
+            # empty-round floor). A >budget hub makes consecutive bounds
+            # equal (slice still <= budget + max_degc); the host skips
+            # zero-width slices and splits over-wide ones.
+            targets = jnp.arange(1, k_max + 1, dtype=jnp.int32) * budget
+            bounds = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.searchsorted(cummass, targets,
+                                  side="right").astype(jnp.int32)])
+            bounds = jnp.minimum(bounds, jnp.int32(n_))
+            bmass = jnp.where(bounds > 0,
+                              cummass[jnp.maximum(bounds - 1, 0)], 0)
+            # pending = improved vertices parked above the bucket; their
+            # minimum value tells the host where the next bucket starts
+            pending = changed & ~inb
+            big = jnp.asarray(FINF if val.dtype == jnp.float32 else IINF,
+                              val.dtype)
+            pmin = jnp.min(jnp.where(pending, val[:n_], big))
+            return jnp.concatenate(
+                [jnp.stack([nf, m8]), bounds, bmass,
+                 jax.lax.bitcast_convert_type(pmin, jnp.int32)[None]
+                 if val.dtype == jnp.float32 else pmin[None]])
         return wrapplan
     return jit_once(f"frontier_wrapplan_{kind}", build)
 
 
 def _push_slice(kind: str):
-    """One SLICE of a frontier-push round: expand frontier[fb:fb+fcnt]'s
-    chunks and relax min(value) into neighbors. The round's changed set
-    is derived afterwards by the wrap/plan diff against ``val_old``, so
-    slices carry no stats and dispatch back-to-back with no syncs."""
+    """One vertex-range SLICE of a frontier-push round: recompute the
+    in-bucket membership mask over [vlo, vhi) from live state (all
+    contiguous dynamic_slice reads — no random gathers outside the
+    essential neighbor fetch/relax), expand the members' chunks, relax
+    min(value) into neighbors, and record the pushed values in
+    ``val_exp``. A member whose chunk range does not fit p_cap (possible
+    when an earlier slice of the same round improved a vertex INTO the
+    bucket after planning) is left unexpanded — still improved, so the
+    next plan picks it up; partial pushes can never mark a vertex
+    expanded."""
     def build():
         import jax
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
                            static_argnames=("f_cap", "p_cap", "n_"),
-                           donate_argnums=(0,))
-        def push(val, frontier, fb, fcnt, dstT, colstart, degc, wparams,
-                 f_cap: int, p_cap: int, n_: int):
-            # the slice start is clamped so dynamic_slice fits, so the
-            # validity window must be expressed in GLOBAL frontier
-            # indices — masking arange(f_cap) < fcnt after a clamp would
-            # re-process earlier vertices and silently skip the tail
-            fbc = jnp.minimum(fb, frontier.shape[0] - f_cap)
-            fvert = jax.lax.dynamic_slice(frontier, (fbc,), (f_cap,))
-            idx = jnp.arange(f_cap) + fbc
-            valid = (idx >= fb) & (idx < fb + fcnt)
-            v = jnp.minimum(fvert, n_)
+                           donate_argnums=(0, 1))
+        def push(val, val_exp, vlo, vhi, bucket_end, dstT, colstart,
+                 degc, wparams, f_cap: int, p_cap: int, n_: int):
+            # clamp so the dynamic_slice fits; validity is expressed in
+            # GLOBAL vertex indices so the clamp shift cannot re-process
+            # earlier vertices or skip the tail
+            v0 = jnp.minimum(vlo, jnp.int32(n_ + 1 - f_cap))
+            v0 = jnp.maximum(v0, 0)
+            idx = v0 + jnp.arange(f_cap, dtype=jnp.int32)
+            valv = jax.lax.dynamic_slice(val, (v0,), (f_cap,))
+            vexp = jax.lax.dynamic_slice(val_exp, (v0,), (f_cap,))
+            degr = jax.lax.dynamic_slice(degc, (v0,), (f_cap,))
+            colr = jax.lax.dynamic_slice(colstart, (v0,), (f_cap,))
+            member = (idx >= vlo) & (idx < vhi) & (idx < n_) \
+                & (valv < vexp) & (valv < bucket_end) & (degr > 0)
+            counts = jnp.where(member, degr, 0).astype(jnp.int32)
+            # only members whose WHOLE chunk range fits p_cap may be
+            # marked expanded (see docstring)
+            ends = jnp.cumsum(counts)
+            fits = member & (ends <= p_cap)
+            vexp2 = jnp.where(fits, valv, vexp)
+            val_exp = jax.lax.dynamic_update_slice(val_exp, vexp2, (v0,))
             cols, _, owner = enumerate_chunk_pairs(
-                valid, degc[v], colstart[v], p_cap, dstT.shape[1] - 1,
+                fits, counts, colr, p_cap, dstT.shape[1] - 1,
                 with_owner=True)
-            src_val = val[v][owner]                   # [p_cap]
+            src_val = valv[owner]                     # [p_cap], 32MB table
             nbr = jnp.take(dstT, cols, axis=1)        # [8, p_cap], pad n+1
             if kind == "sssp":
                 lane = jnp.arange(8, dtype=jnp.int32)[:, None]
@@ -183,7 +213,7 @@ def _push_slice(kind: str):
                 msg = src_val[None, :] + w
             else:
                 msg = jnp.broadcast_to(src_val[None, :], nbr.shape)
-            return val.at[nbr].min(msg, mode="drop")
+            return val.at[nbr].min(msg, mode="drop"), val_exp
         return push
     return jit_once(f"frontier_push_{kind}", build)
 
@@ -196,26 +226,39 @@ def _max_degc(g) -> int:
     return got
 
 
-def _frontier_run(snap_or_graph, val, val_old, kind: str, wparams,
-                  max_rounds: int):
-    """Round loop: one wrap/plan readback per round, then budget-sliced
-    push dispatches (work tracks the actual relaxation mass). Relaxations
-    from earlier slices are visible to later ones in the same round —
-    min-relax only converges faster for it."""
+# vertex-range slice width: sparse rounds dispatch >= n/width slices, so
+# width trades dispatch count against the src_val gather table size
+# (2^23 int32 = 32MB, the last fast-gather size — see PERF_NOTES.md)
+SLICE_WIDTH = 1 << 23
+
+
+def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
+                  max_rounds: int, delta: float | None = None):
+    """Expansion-tracked round loop: one plan readback per round, then
+    budget-bounded vertex-range push dispatches. With ``delta``, rounds
+    expand only the current distance bucket (one-sided) and the bucket
+    advances to the minimum pending value when it drains —
+    delta-stepping. Without it, every improved vertex is eligible every
+    round."""
     import jax.numpy as jnp
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
     n = g["n"]
     dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
-    cap_n = _next_pow2(max(n, 2))
     push = _push_slice(kind)
     wrapplan = _wrap_plan(kind)
     max_dc = _max_degc(g)
+    is_f32 = val.dtype == jnp.float32
+    big = float(FINF) if is_f32 else int(IINF)
+    # dynamic_slice needs f_cap <= n+1: cap the range width at the
+    # largest power of two that fits the state arrays
+    w_max = 1 << ((n + 1).bit_length() - 1)
+    width = min(SLICE_WIDTH, w_max)
     # a slice carries up to budget + max_dc chunks (one vertex of
     # overshoot), so budget == 2^k would push p_cap to 2^(k+1) and HALF
     # of every big slice's lanes would be padding — shave max_dc off the
-    # budget instead so full slices fit a 2^k kernel exactly (measured
+    # budget so full slices fit a 2^k kernel exactly (measured
     # 2026-07-31: scale-26 SSSP round cost is dominated by these lanes)
     target = _next_pow2(max(SLICE_BUDGET_CHUNKS, 2))
     if max_dc <= target // 2:
@@ -226,63 +269,131 @@ def _frontier_run(snap_or_graph, val, val_old, kind: str, wparams,
         p_full = _next_pow2(max(budget + max_dc, 2))
 
     wp = jnp.asarray(np.asarray(wparams, np.float32))
-    rounds = 0
-    while rounds < max_rounds:
-        fb0 = 0
-        done_round = False
-        round_start = None
-        while not done_round:
-            # continuations (fb0 > 0, rare: only when a round needs more
-            # than SLICE_K_MAX slices) re-plan from the FROZEN round-start
-            # diff so the frontier indices don't shift mid-round
-            frontier, plan = wrapplan(
-                round_start if round_start is not None else val,
-                val_old, degc, jnp.int32(fb0), n_=n, cap=cap_n,
-                k_max=SLICE_K_MAX, budget=budget)
-            plan_h = np.asarray(plan)          # ONE sync per plan
-            nf, m8, wmax = (int(x) for x in plan_h[:3])
-            bounds = plan_h[3:]
-            if nf == 0 or m8 == 0:
-                return val[:n], rounds
-            if round_start is None:
-                # a REAL copy: the first push donates val's buffer
-                round_start = jnp.copy(val)
-            f_cap = min(_next_pow2(max(wmax, 2)), cap_n)
-            p_cap = min(_next_pow2(max(m8 + max_dc, 2)), p_full)
-            for i in range(SLICE_K_MAX):
-                fb, fe = int(bounds[i]), int(bounds[i + 1])
-                if fe <= fb:
-                    break
-                val = push(val, frontier, jnp.int32(fb),
-                           jnp.int32(fe - fb), dstT, colstart, degc, wp,
-                           f_cap=f_cap, p_cap=p_cap, n_=n)
-            if int(bounds[-1]) >= nf:
-                done_round = True
-            else:
-                fb0 = int(bounds[-1])
-        val_old = round_start
+    bucket_end = big if not delta or delta <= 0 else delta
+    trace = g.get("_trace_rounds")      # optional perf instrumentation:
+    rounds = 0                          # set g["_trace_rounds"] = [] to
+    while rounds < max_rounds:          # collect (bucket_end, nf, m8)
+        be_dev = jnp.asarray(bucket_end, val.dtype)
+        plan = wrapplan(val, val_exp, degc, be_dev, n_=n,
+                        k_max=SLICE_K_MAX, budget=budget)
+        plan_h = np.asarray(plan)          # ONE sync per round
+        nf, m8 = (int(x) for x in plan_h[:2])
+        bounds = plan_h[2:2 + SLICE_K_MAX + 1]
+        bmass = plan_h[3 + SLICE_K_MAX:3 + 2 * SLICE_K_MAX + 1]
+        pmin = plan_h[-1].view(np.float32) if is_f32 else plan_h[-1]
+        if trace is not None:
+            import time as _t
+            trace.append((float(bucket_end), nf, m8, _t.time()))
+        if nf == 0 or m8 == 0:
+            if float(pmin) >= big * (1 - 1e-6):
+                return val[:n], rounds     # no pending work anywhere
+            # bucket drained: advance to the minimum pending value's
+            # bucket (strictly increases — pmin >= current bucket_end)
+            bucket_end = (np.floor(float(pmin) / delta) + 1) * delta
+            continue
+        p_cap = min(_next_pow2(max(min(m8, budget) + max_dc, 2)), p_full)
+        for i in range(SLICE_K_MAX):
+            vlo, vhi = int(bounds[i]), int(bounds[i + 1])
+            # equal bounds = a >budget hub straddling the target (or
+            # coverage exhausted); zero-mass slices carry no members
+            if vhi <= vlo or int(bmass[i + 1]) == int(bmass[i]):
+                continue
+            # host-side width split keeps f_cap a SINGLE static shape
+            for sub in range(vlo, vhi, width):
+                val, val_exp = push(
+                    val, val_exp, jnp.int32(sub),
+                    jnp.int32(min(sub + width, vhi)), be_dev, dstT,
+                    colstart, degc, wp, f_cap=width, p_cap=p_cap, n_=n)
         rounds += 1
     return val[:n], rounds
 
 
 def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
                   w_range: float = 1.0, max_rounds: int = 10_000,
+                  delta: float | None = None,
                   return_device: bool = False):
-    """Bellman-Ford SSSP with an improvement frontier over hashed edge
-    weights. Returns (dist float32 [n] with FINF unreachable, rounds)."""
+    """Delta-stepping SSSP over hashed edge weights. Returns (dist
+    float32 [n] with FINF unreachable, rounds). ``delta`` defaults to
+    w_range/4 (tuned on v5e at scale 23/26; 0 or None with w_range == 0
+    degenerates to the plain improvement frontier)."""
     import jax.numpy as jnp
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
     n = g["n"]
+    if delta is None:
+        delta = w_range / 4.0 if w_range > 0 else 0.0
     val = jnp.full((n + 1,), FINF, jnp.float32).at[source_dense].set(0.0)
-    # synthetic previous state: only the source reads as "improved"
-    val_old = jnp.full((n + 1,), FINF, jnp.float32)
-    out, rounds = _frontier_run(g, val, val_old, "sssp",
-                                (min_w, w_range), max_rounds)
+    # nothing has pushed yet: only the source reads as improved
+    # (val < val_exp); unreached vertices sit at val == val_exp == FINF
+    val_exp = jnp.full((n + 1,), FINF, jnp.float32)
+    out, rounds = _frontier_run(g, val, val_exp, "sssp",
+                                (min_w, w_range), max_rounds, delta=delta)
     if not return_device:
         out = np.asarray(out)
     return out, rounds
+
+
+def _wcc_seed_labels():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_",))
+        def seed(dist, n_: int):
+            """Label arrays from a finished BFS: the reached component
+            collapses to its minimum vertex id (already expanded — a
+            closed component never pushes again); the rest start at
+            their own id, improved-state so round 1 expands them."""
+            ids = jnp.arange(n_, dtype=jnp.int32)
+            reached = dist[:n_] < INF
+            rmin = jnp.min(jnp.where(reached, ids, IINF))
+            lab = jnp.where(reached, rmin, ids)
+            val = jnp.concatenate([lab, jnp.full((1,), IINF, jnp.int32)])
+            exp = jnp.concatenate(
+                [jnp.where(reached, lab, lab + 1),
+                 jnp.full((1,), IINF, jnp.int32)])
+            return val, exp
+        return seed
+    return jit_once("wcc_seed_labels", build)
+
+
+def pagerank_dense(snap_or_graph, iterations: int = 20,
+                   damping: float = 0.85, tol: float | None = None,
+                   return_device: bool = False):
+    """Push-mode PageRank over the chunked CSR via dense window sweeps:
+    rank' = (1-d)/n + d * sum over in-edges of rank[src]/outdeg[src]
+    (semantics match the pull-mode engine program in models/pagerank.py,
+    incl. leaking dangling mass). Returns (rank float32 [n], iterations
+    run). ``tol``: early exit when the L1 delta falls below it."""
+    import jax.numpy as jnp
+
+    g = snap_or_graph if isinstance(snap_or_graph, dict) \
+        else build_chunked_csr(snap_or_graph)
+    n = g["n"]
+    dstT = g["dstT"]
+    deg = g["deg"].astype(jnp.float32)
+    colowner = _colowner(g)
+    total = g["q_total"]
+    W = min(DENSE_WINDOW, total)
+    win = _pr_window()
+    fin = _pr_finish()
+    rank = jnp.full((n + 1,), 1.0 / n, jnp.float32) \
+        .at[n].set(0.0)
+    contrib = jnp.where(deg > 0, rank / jnp.maximum(deg, 1.0), 0.0)
+    it = 0
+    for it in range(1, iterations + 1):
+        acc = jnp.zeros((n + 1,), jnp.float32)
+        for w0 in range(0, total, W):
+            acc = win(acc, contrib, jnp.int32(w0), dstT, colowner, W=W)
+        rank, contrib, delta = fin(acc, rank, deg,
+                                   jnp.float32(damping), n_=n)
+        if tol is not None and float(delta) < tol:
+            break
+    out = rank[:n]
+    if not return_device:
+        out = np.asarray(out)
+    return out, it
 
 
 def _pr_window():
@@ -324,60 +435,33 @@ def _pr_finish():
     return jit_once("pagerank_finish", build)
 
 
-def pagerank_dense(snap_or_graph, iterations: int = 20,
-                   damping: float = 0.85, tol: float | None = None,
-                   return_device: bool = False):
-    """Push-mode PageRank over the chunked CSR via dense window sweeps:
-    rank' = (1-d)/n + d * sum over in-edges of rank[src]/outdeg[src]
-    (semantics match the pull-mode engine program in models/pagerank.py,
-    incl. leaking dangling mass). Returns (rank float32 [n], iterations
-    run). ``tol``: early exit when the L1 delta falls below it."""
-    import jax.numpy as jnp
-
-    g = snap_or_graph if isinstance(snap_or_graph, dict) \
-        else build_chunked_csr(snap_or_graph)
-    n = g["n"]
-    dstT = g["dstT"]
-    deg = g["deg"].astype(jnp.float32)
-    colowner = _colowner(g)
-    total = g["q_total"]
-    W = min(DENSE_WINDOW, total)
-    win = _pr_window()
-    fin = _pr_finish()
-    rank = jnp.full((n + 1,), 1.0 / n, jnp.float32) \
-        .at[n].set(0.0)
-    contrib = jnp.where(deg > 0, rank / jnp.maximum(deg, 1.0), 0.0)
-    it = 0
-    for it in range(1, iterations + 1):
-        acc = jnp.zeros((n + 1,), jnp.float32)
-        for w0 in range(0, total, W):
-            acc = win(acc, contrib, jnp.int32(w0), dstT, colowner, W=W)
-        rank, contrib, delta = fin(acc, rank, deg,
-                                   jnp.float32(damping), n_=n)
-        if tol is not None and float(delta) < tol:
-            break
-    out = rank[:n]
-    if not return_device:
-        out = np.asarray(out)
-    return out, it
-
-
 def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
                  return_device: bool = False):
-    """Min-label propagation with an active set (symmetrized graphs).
-    Returns (label int32 [n] = component minimum vertex id, rounds)."""
+    """Hybrid connected components (symmetrized graphs): peel the seed
+    vertex's whole component with one direction-optimized BFS, then run
+    min-label propagation over the remaining components only. Returns
+    (label int32 [n] = component minimum vertex id, rounds) where
+    rounds counts BFS levels + propagation rounds."""
     import jax.numpy as jnp
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
     n = g["n"]
-    # labels live in [0, n); the sink slot n stays at IINF. The synthetic
-    # previous state reads every vertex as "improved" (round 1 = all)
-    val = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
-                           jnp.full((1,), IINF, jnp.int32)])
-    val_old = val + 1
-    out, rounds = _frontier_run(g, val, val_old, "wcc", (0.0, 0.0),
+    if n == 0:
+        out = jnp.zeros((0,), jnp.int32)
+        return (out if return_device else np.asarray(out)), 0
+    # seed at the max-degree vertex — on power-law graphs it anchors the
+    # giant component, so the BFS peels ~all edge mass
+    seed_v = int(np.asarray(jnp.argmax(g["deg"][:n])))
+    # max_levels=n: a truncated BFS would freeze the partially-peeled
+    # region as expanded, silently splitting its component's labels
+    dist, levels = frontier_bfs_hybrid(g, seed_v, max_levels=n,
+                                       return_device=True)
+    # frontier_bfs_hybrid returns dist[:n]; the seeding jit re-appends
+    # nothing — it only reads [:n_]
+    val, val_exp = _wcc_seed_labels()(dist, n_=n)
+    out, rounds = _frontier_run(g, val, val_exp, "wcc", (0.0, 0.0),
                                 max_rounds)
     if not return_device:
         out = np.asarray(out)
-    return out, rounds
+    return out, rounds + levels
