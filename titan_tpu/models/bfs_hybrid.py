@@ -261,8 +261,12 @@ def _td_step():
         @functools.partial(jax.jit,
                            static_argnames=("f_cap", "p_cap", "n_"),
                            donate_argnums=(0,))
-        def td(dist, frontier, f_count, level, dstT, colstart, degc,
+        def td(dist, frontier, stats, level, dstT, colstart, degc,
                f_cap: int, p_cap: int, n_: int):
+            # frontier count arrives as the previous step's DEVICE stats
+            # vector — shipping it back as a scalar would cost a tunnel
+            # round trip per level (~0.1s fast day, ~0.9s slow day)
+            f_count = stats[0]
             valid = jnp.arange(f_cap) < f_count
             v = jnp.minimum(frontier, n_)
             cols, _, _ = enumerate_chunk_pairs(
@@ -342,12 +346,13 @@ def _bu_more():
         @functools.partial(jax.jit,
                            static_argnames=("c_cap", "n_", "fuse"),
                            donate_argnums=(0,))
-        def bu(dist, fbits, cand, off, c_count, level, dstT, colstart,
+        def bu(dist, fbits, cand, off, prog, level, dstT, colstart,
                degc, c_cap: int, n_: int, fuse: int):
             """``fuse`` chunk-check rounds over the compacted survivor
             list (bitmap hit test), with the level-end stats under
             lax.cond when the survivors die out inside."""
-            q_pad = dstT.shape[1] - 1
+            c_count = prog[0]      # survivor count from the DEVICE
+            q_pad = dstT.shape[1] - 1      # progress vector (no put)
 
             def round_(state, _):
                 dist, cand, off, c_count = state
@@ -392,11 +397,12 @@ def _bu_exhaust():
         @functools.partial(jax.jit,
                            static_argnames=("c_cap", "p_cap", "n_"),
                            donate_argnums=(0,))
-        def ex(dist, fbits, cand, off, c_count, level, dstT, colstart,
+        def ex(dist, fbits, cand, off, prog, level, dstT, colstart,
                degc, c_cap: int, p_cap: int, n_: int):
             """One masked sweep over ALL remaining chunks of the surviving
             candidates (rare: frontier-less hubs / small components), then
             the level-end stats (always needed here)."""
+            c_count = prog[0]
             valid = jnp.arange(c_cap) < c_count
             v = jnp.minimum(cand, n_)
             rem = jnp.maximum(degc[v] - off, 0)
@@ -529,14 +535,17 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
                 [a, jnp.full((cap_n - a.shape[0],), n, a.dtype)])
         return a
 
+    from titan_tpu.utils.jitcache import dev_scalar
+
     # ---- fused head: source + early top-down levels, one readback
     f_cap_h = min(HEAD_F_CAP, cap_n)
     p_cap_h = min(HEAD_P_CAP, _next_pow2(max(total_chunks + n, 2)))
-    dist, frontier, st = head(jnp.int32(source_dense),
-                              jnp.int32(max_levels), dstT, colstart,
-                              degc, f_cap=f_cap_h, p_cap=p_cap_h, n_=n)
+    dist, frontier, st_dev = head(dev_scalar(source_dense),
+                                  dev_scalar(max_levels), dstT, colstart,
+                                  degc, f_cap=f_cap_h, p_cap=p_cap_h,
+                                  n_=n)
     f_count, m8_f, m8_unvis, n_unvis, level = \
-        (int(x) for x in np.asarray(st))
+        (int(x) for x in np.asarray(st_dev))
     # head refusal (source mass > p_cap_h) returns its initial state:
     # f_count=1, frontier=[source], level=0 — the main loop just takes over
     frontier = pad(frontier) if f_count <= f_cap_h else None
@@ -546,8 +555,8 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
         if n_unvis <= END_C_CAP and m8_unvis <= END_P_CAP:
             c_cap = _next_pow2(max(n_unvis, 2))
             p_cap = _next_pow2(max(m8_unvis, 2))
-            dist, iters = endgame(dist, jnp.int32(level),
-                                  jnp.int32(max_levels), dstT, colstart,
+            dist, iters = endgame(dist, dev_scalar(level),
+                                  dev_scalar(max_levels), dstT, colstart,
                                   degc, c_cap=c_cap, p_cap=p_cap, n_=n)
             # +1: the empty probe level, matching the host loop's count
             level = min(level + int(np.asarray(iters)) + 1, max_levels)
@@ -558,21 +567,22 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
             if m8_f == 0:
                 break
             if frontier is None:      # after bottom-up / head overflow
-                frontier = pad(frontier_of(dist, jnp.int32(level), n_=n))
+                frontier = pad(frontier_of(dist, dev_scalar(level),
+                                           n_=n))
             f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
             p_cap = min(_next_pow2(max(m8_f, 2)),
                         _next_pow2(max(total_chunks + n, 2)))
-            dist, frontier, st = td(
-                dist, frontier[:f_cap], jnp.int32(f_count),
-                jnp.int32(level), dstT, colstart, degc,
+            dist, frontier, st_dev = td(
+                dist, frontier[:f_cap], st_dev,
+                dev_scalar(level), dstT, colstart, degc,
                 f_cap=f_cap, p_cap=p_cap, n_=n)
             frontier = pad(frontier)
             f_count, m8_f, m8_unvis, n_unvis = \
-                (int(x) for x in np.asarray(st))
+                (int(x) for x in np.asarray(st_dev))
         else:
             c_cap = min(_next_pow2(max(n_unvis, 2)), cap_n)
-            dist, fbits, cand, prog, st = bu0(
-                dist, jnp.int32(level), dstT, colstart, degc,
+            dist, fbits, cand, prog, st_dev = bu0(
+                dist, dev_scalar(level), dstT, colstart, degc,
                 c_cap=c_cap, n_=n)
             nc, rem8 = (int(x) for x in np.asarray(prog))
             rounds = 1
@@ -583,9 +593,9 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
                     cand = pad(cand)
                     off = jnp.ones((cap_n,), jnp.int32)
                 fuse = BU_CHUNK_ROUNDS - rounds
-                dist, cand, off, prog, st = bu(
+                dist, cand, off, prog, st_dev = bu(
                     dist, fbits, cand[:c_cap2], off[:c_cap2],
-                    jnp.int32(nc), jnp.int32(level), dstT, colstart,
+                    prog, dev_scalar(level), dstT, colstart,
                     degc, c_cap=c_cap2, n_=n, fuse=fuse)
                 cand, off = pad(cand), pad(off)
                 nc, rem8 = (int(x) for x in np.asarray(prog))
@@ -597,12 +607,12 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
                 if off is None:
                     cand = pad(cand)
                     off = jnp.ones((cap_n,), jnp.int32)
-                dist, st = ex(dist, fbits, cand[:c_cap2], off[:c_cap2],
-                              jnp.int32(nc), jnp.int32(level), dstT,
-                              colstart, degc, c_cap=c_cap2,
-                              p_cap=rem_cap, n_=n)
+                dist, st_dev = ex(dist, fbits, cand[:c_cap2],
+                                  off[:c_cap2], prog, dev_scalar(level),
+                                  dstT, colstart, degc, c_cap=c_cap2,
+                                  p_cap=rem_cap, n_=n)
             f_count, m8_f, m8_unvis, n_unvis = \
-                (int(x) for x in np.asarray(st))
+                (int(x) for x in np.asarray(st_dev))
             frontier = None
         level += 1
     out = dist[:n]

@@ -163,11 +163,14 @@ def _td_expand():
         @functools.partial(
             jax.jit,
             static_argnames=("mesh", "f_cap", "p_cap", "n_", "b_max"))
-        def td(dist, frontier, f_count, level, dstT_sh, colstart_sh,
+        def td(dist, frontier, stats, level, dstT_sh, colstart_sh,
                degc_sh, lo_sh, hi_sh, mesh, f_cap: int, p_cap: int,
                n_: int, b_max: int):
-            """Local expansion: returns the per-chip updated dist and the
-            [D] per-chip newly-found counts (replicated)."""
+            """Local expansion: returns the per-chip updated dist.
+            The frontier count arrives as the previous exchange's DEVICE
+            stats vector (stats[0]) — a per-level scalar put would cost
+            a tunnel round trip."""
+            f_count = stats[0]
             def per_shard(dist, frontier, dstT_l, cs_l, degc_l, lo, hi):
                 dstT_l, cs_l, degc_l = dstT_l[0], cs_l[0], degc_l[0]
                 lo, hi = lo[0], hi[0]
@@ -368,11 +371,14 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
     # dist flow: replicated [n+1] into td/bu (each chip updates its own
     # copy -> [D, n+1] out), merged back to replicated [n+1] by the
     # exchange
+    from titan_tpu.utils.jitcache import dev_scalar
+
     dist = jnp.full((n + 1,), INF, jnp.int32).at[source_dense].set(0)
     frontier = pad(jnp.full((1,), source_dense, jnp.int32))
     f_count = 1
     m8_f = int(np.asarray(degc[source_dense]))
     m8_unvis = total_chunks - m8_f
+    st_dev = jnp.asarray([1, m8_f, m8_unvis, 0], jnp.int32)
     level = 0
     found_guess = 4
     LAST_EXCHANGE_CAPS.clear()
@@ -388,14 +394,14 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
             # chunk total is a safe upper bound for every shard
             p_cap = min(_next_pow2(max(m8_f, 2)),
                         _next_pow2(max(total_chunks + n, 2)))
-            dist_sh = td(dist, frontier[:f_cap], jnp.int32(f_count),
-                         jnp.int32(level), dstT_sh, colstart_sh,
+            dist_sh = td(dist, frontier[:f_cap], st_dev,
+                         dev_scalar(level), dstT_sh, colstart_sh,
                          degc_sh, lo_sh, hi_sh, mesh=mesh,
                          f_cap=f_cap, p_cap=p_cap, n_=n, b_max=b_max)
         else:
             c_cap = _next_pow2(max(b_max, 2))
             p_cap = _next_pow2(max(sh["q_max"], 2))
-            dist_sh = bu(dist, jnp.int32(level), dstT_sh,
+            dist_sh = bu(dist, dev_scalar(level), dstT_sh,
                          colstart_sh, degc_sh, lo_sh, hi_sh,
                          mesh=mesh, c_cap=c_cap, p_cap=p_cap, n_=n,
                          b_max=b_max, rounds=BU_CHUNK_ROUNDS)
@@ -406,7 +412,7 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
         # guess tracks 4x the previous level's max)
         found_cap, retries = found_guess, 0
         while True:
-            dist_m, frontier, st = ex(dist_sh, jnp.int32(level), degc,
+            dist_m, frontier, st = ex(dist_sh, dev_scalar(level), degc,
                                       mesh=mesh, found_cap=found_cap,
                                       n_=n)
             f_count, m8_f, m8_unvis, found_max = \
@@ -416,6 +422,7 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
             found_cap = _next_pow2(max(found_max, 2))
             retries += 1
         dist = dist_m
+        st_dev = st
         frontier = pad(frontier)
         LAST_EXCHANGE_CAPS.append(found_cap)
         LAST_PROFILE.append({

@@ -45,7 +45,7 @@ from titan_tpu.models.bfs_hybrid import (build_chunked_csr,
                                          enumerate_chunk_pairs,
                                          frontier_bfs_hybrid)
 from titan_tpu.models.bfs import INF, _next_pow2
-from titan_tpu.utils.jitcache import jit_once
+from titan_tpu.utils.jitcache import dev_scalar, jit_once
 
 FINF = np.float32(3.0e38)
 IINF = np.int32(1 << 30)
@@ -154,10 +154,15 @@ def _wrap_plan(kind: str):
             big = jnp.asarray(FINF if val.dtype == jnp.float32 else IINF,
                               val.dtype)
             pmin = jnp.min(jnp.where(pending, val[:n_], big))
-            return jnp.concatenate(
+            plan = jnp.concatenate(
                 [jnp.stack([nf, m8]), bounds, bmass,
                  jax.lax.bitcast_convert_type(pmin, jnp.int32)[None]
                  if val.dtype == jnp.float32 else pmin[None]])
+            # bounds returned separately ON DEVICE: push slices read
+            # their vertex range from it via pooled index scalars, so
+            # the host never ships per-slice bounds (each scalar put is
+            # a ~0.1-0.9s tunnel round trip)
+            return plan, bounds
         return wrapplan
     return jit_once(f"frontier_wrapplan_{kind}", build)
 
@@ -180,8 +185,14 @@ def _push_slice(kind: str):
         @functools.partial(jax.jit,
                            static_argnames=("f_cap", "p_cap", "n_"),
                            donate_argnums=(0, 1))
-        def push(val, val_exp, vlo, vhi, bucket_end, dstT, colstart,
-                 degc, wparams, f_cap: int, p_cap: int, n_: int):
+        def push(val, val_exp, bounds, idx, sub, bucket_end, dstT,
+                 colstart, degc, wparams, f_cap: int, p_cap: int,
+                 n_: int):
+            # the slice's vertex range comes from the DEVICE bounds
+            # array (idx/sub are pooled scalars — no per-call host
+            # transfers): range = width-window `sub` of plan slice `idx`
+            vlo = bounds[idx] + sub * f_cap
+            vhi = jnp.minimum(bounds[idx + 1], vlo + f_cap)
             # clamp so the dynamic_slice fits; validity is expressed in
             # GLOBAL vertex indices so the clamp shift cannot re-process
             # earlier vertices or skip the tail
@@ -272,10 +283,13 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
     bucket_end = big if not delta or delta <= 0 else delta
     trace = g.get("_trace_rounds")      # optional perf instrumentation:
     rounds = 0                          # set g["_trace_rounds"] = [] to
+    dtname = "float32" if is_f32 else "int32"
+    prev_sig = None
+    escalate = False
     while rounds < max_rounds:          # collect (bucket_end, nf, m8)
-        be_dev = jnp.asarray(bucket_end, val.dtype)
-        plan = wrapplan(val, val_exp, degc, be_dev, n_=n,
-                        k_max=SLICE_K_MAX, budget=budget)
+        be_dev = dev_scalar(bucket_end, dtname)
+        plan, bounds_dev = wrapplan(val, val_exp, degc, be_dev, n_=n,
+                                    k_max=SLICE_K_MAX, budget=budget)
         plan_h = np.asarray(plan)          # ONE sync per round
         nf, m8 = (int(x) for x in plan_h[:2])
         bounds = plan_h[2:2 + SLICE_K_MAX + 1]
@@ -289,21 +303,37 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
                 return val[:n], rounds     # no pending work anywhere
             # bucket drained: advance to the minimum pending value's
             # bucket (strictly increases — pmin >= current bucket_end)
-            bucket_end = (np.floor(float(pmin) / delta) + 1) * delta
+            bucket_end = float((np.floor(float(pmin) / delta) + 1)
+                               * delta)
             continue
-        p_cap = min(_next_pow2(max(min(m8, budget) + max_dc, 2)), p_full)
+        # a round that changed NOTHING means every remaining member was
+        # fits-deferred (its chunk range exceeded the tight p_cap) —
+        # escalate to full-size kernels for one round
+        sig = (nf, m8, float(pmin), float(bucket_end))
+        escalate = sig == prev_sig
+        prev_sig = sig
         for i in range(SLICE_K_MAX):
             vlo, vhi = int(bounds[i]), int(bounds[i + 1])
             # equal bounds = a >budget hub straddling the target (or
             # coverage exhausted); zero-mass slices carry no members
             if vhi <= vlo or int(bmass[i + 1]) == int(bmass[i]):
                 continue
-            # host-side width split keeps f_cap a SINGLE static shape
-            for sub in range(vlo, vhi, width):
+            # per-slice p_cap from the plan's mass column: a kernel
+            # pays its FULL p_cap whether or not lanes are live
+            # (measured 1.15s for a ZERO-mass 2^23 dispatch, 0.2s at
+            # 2^18), so sparse slices get kernels sized to their mass.
+            # No max_dc pad: a member whose chunks exceed p_cap is
+            # fits-deferred, and the stall signature above escalates.
+            mass_i = int(bmass[i + 1]) - int(bmass[i])
+            p_cap = p_full if escalate else min(
+                _next_pow2(max(mass_i, 2)), p_full)
+            # device-side width split: sub index selects a width-window
+            # of slice i, both from the scalar pool — no host puts
+            for j in range((vhi - vlo + width - 1) // width):
                 val, val_exp = push(
-                    val, val_exp, jnp.int32(sub),
-                    jnp.int32(min(sub + width, vhi)), be_dev, dstT,
-                    colstart, degc, wp, f_cap=width, p_cap=p_cap, n_=n)
+                    val, val_exp, bounds_dev, dev_scalar(i),
+                    dev_scalar(j), be_dev, dstT, colstart, degc, wp,
+                    f_cap=width, p_cap=p_cap, n_=n)
         rounds += 1
     return val[:n], rounds
 
@@ -312,17 +342,25 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
                   w_range: float = 1.0, max_rounds: int = 10_000,
                   delta: float | None = None,
                   return_device: bool = False):
-    """Delta-stepping SSSP over hashed edge weights. Returns (dist
-    float32 [n] with FINF unreachable, rounds). ``delta`` defaults to
-    w_range/4 (tuned on v5e at scale 23/26; 0 or None with w_range == 0
-    degenerates to the plain improvement frontier)."""
+    """SSSP over hashed edge weights with an expansion-tracked frontier;
+    ``delta`` > 0 adds delta-stepping buckets. Returns (dist float32 [n]
+    with FINF unreachable, rounds).
+
+    Default is NO buckets: on hub-dominated power-law graphs the
+    shortest-path distances concentrate in a band narrower than any
+    useful bucket width (measured scale-26 R-MAT: ~all mass lands in
+    one bucket at delta=1/4 through 1/32, total relaxation mass floors
+    at ~3.2x E/8 regardless), so buckets only add rounds — scale-26 on
+    v5e: delta=0 270s/26 rounds vs delta=0.125 300s/64 rounds. On
+    graphs with spread distance distributions (road networks, uniform
+    meshes) pass delta ~ mean edge weight."""
     import jax.numpy as jnp
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
     n = g["n"]
     if delta is None:
-        delta = w_range / 4.0 if w_range > 0 else 0.0
+        delta = 0.0
     val = jnp.full((n + 1,), FINF, jnp.float32).at[source_dense].set(0.0)
     # nothing has pushed yet: only the source reads as improved
     # (val < val_exp); unreached vertices sit at val == val_exp == FINF
@@ -385,7 +423,9 @@ def pagerank_dense(snap_or_graph, iterations: int = 20,
     for it in range(1, iterations + 1):
         acc = jnp.zeros((n + 1,), jnp.float32)
         for w0 in range(0, total, W):
-            acc = win(acc, contrib, jnp.int32(w0), dstT, colowner, W=W)
+            # pooled window starts: a fresh scalar put per window costs
+            # a tunnel round trip (64 windows/iteration at scale 26)
+            acc = win(acc, contrib, dev_scalar(w0), dstT, colowner, W=W)
         rank, contrib, delta = fin(acc, rank, deg,
                                    jnp.float32(damping), n_=n)
         if tol is not None and float(delta) < tol:

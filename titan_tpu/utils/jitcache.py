@@ -22,3 +22,28 @@ def jit_once(key: str, builder: Callable):
         fn = builder()
         _JITS[key] = fn
     return fn
+
+
+# ---------------------------------------------------------------------------
+# device-scalar pool
+# ---------------------------------------------------------------------------
+# Every host->device transfer of a bare scalar costs a full tunnel round
+# trip (~0.1s fast day, ~0.9s slow day — measured 2026-07-31, and they do
+# NOT pipeline: 20 puts took 1.9s). Host-driven loops that pass
+# jnp.int32(...) per call silently pay this on EVERY dispatch, which
+# dominated SSSP/PageRank rounds. Reused scalar values (loop levels,
+# slice indices, window starts, thresholds) must come from this pool so
+# each distinct value is shipped ONCE per process.
+
+_SCALARS: dict = {}
+
+
+def dev_scalar(value, dtype: str = "int32"):
+    """A cached device scalar for ``value`` (ship-once semantics)."""
+    key = (dtype, value)
+    got = _SCALARS.get(key)
+    if got is None:
+        import jax.numpy as jnp
+        got = jnp.asarray(value, dtype=getattr(jnp, dtype))
+        _SCALARS[key] = got
+    return got
