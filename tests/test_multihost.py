@@ -1,0 +1,25 @@
+"""Multi-host (DCN) execution dryrun (SURVEY §2.8: the JAX distributed
+runtime across hosts is the rebuild's cross-host data plane, replacing
+the reference's Hadoop InputFormat distribution —
+titan-hadoop-core/.../scan/HadoopScanMapper.java:33).
+
+Spawns 2 real processes x 4 virtual CPU devices each, joined via
+jax.distributed into one 8-device mesh; the sharded hybrid BFS runs with
+HOST-SHARDED loading (each process materializes only its own shard
+blocks) and must be bit-equal to the single-chip hybrid.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+
+def test_multihost_dryrun():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(here, "__graft_entry__.py"))
+    ge = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ge)
+    # raises on rc != 0, missing OK line, or bit-inequality
+    ge.dryrun_multihost(n_processes=2, per_proc_devices=4, scale=12)

@@ -572,10 +572,11 @@ class GraphTransaction:
         (cites the same fast-shape rules as olap/tpu/snapshot._scan_native):
         returns a list aligned with ``items`` holding
         (relation_id, type_id, other_vertex_id) for entries of MULTI
-        labels with no sort key and an empty property section (value ==
-        b"\\x00" — the codec writes property count 0 as one byte), and
-        None where the per-entry parser must run. Returns None when the
-        native codec is unavailable."""
+        labels with no sort key and an empty property section (the value
+        is exactly the codec's uvar encoding of property-count 0 — one
+        0x80 byte in the MSB-terminated scheme, see
+        _empty_props_bytes), and None where the per-entry parser must
+        run. Returns None when the native codec is unavailable."""
         from titan_tpu import native
         if not native.available:
             return None

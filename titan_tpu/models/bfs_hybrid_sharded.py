@@ -82,6 +82,29 @@ def plan_shard_cuts(colstart: np.ndarray, n: int, num_shards: int):
     return bounds, b_max, q_max
 
 
+def pack_shard_block(d: int, colstart: np.ndarray, dstT: np.ndarray,
+                     degc_all: np.ndarray, bounds: np.ndarray,
+                     b_max: int, q_max: int, n: int):
+    """Pack vertex block ``d`` into the padded per-shard layout:
+    (dstT [8, q_max] pad n+1, LOCAL colstart [b_max+1] with the tail
+    held at the last live value, degc [b_max]). The ONLY definition of
+    the shard block layout — shard_chunked_csr (single-host) and the
+    multihost host-sharded loader both call it, so the two paths cannot
+    drift."""
+    dstT_b = np.full((8, q_max), n + 1, np.int32)
+    cs_b = np.zeros(b_max + 1, np.int32)
+    degc_b = np.zeros(b_max, np.int32)
+    if d < len(bounds) - 1 and bounds[d] < bounds[d + 1]:
+        lo, hi = int(bounds[d]), int(bounds[d + 1])
+        c0, c1 = int(colstart[lo]), int(colstart[hi])
+        dstT_b[:, :c1 - c0] = dstT[:, c0:c1]
+        local = (colstart[lo:hi + 1] - c0).astype(np.int32)
+        cs_b[:hi - lo + 1] = local
+        cs_b[hi - lo + 1:] = local[-1]
+        degc_b[:hi - lo] = degc_all[lo:hi]
+    return dstT_b, cs_b, degc_b
+
+
 def shard_chunked_csr(snap_or_graph, num_shards: int):
     """Edge-balanced vertex-range shards of the chunked CSR, padded to
     uniform shapes: dict with ``dstT_sh`` [D, 8, Qmax] (pad n+1),
@@ -125,13 +148,8 @@ def shard_chunked_csr(snap_or_graph, num_shards: int):
     colstart_sh = np.zeros((num_shards, b_max + 1), np.int32)
     degc_sh = np.zeros((num_shards, b_max), np.int32)
     for d in range(d_eff):
-        lo, hi = int(bounds[d]), int(bounds[d + 1])
-        c0, c1 = int(colstart[lo]), int(colstart[hi])
-        dstT_sh[d, :, :c1 - c0] = dstT[:, c0:c1]
-        local = (colstart[lo:hi + 1] - c0).astype(np.int32)
-        colstart_sh[d, :hi - lo + 1] = local
-        colstart_sh[d, hi - lo + 1:] = local[-1]
-        degc_sh[d, :hi - lo] = degc_all[lo:hi]
+        dstT_sh[d], colstart_sh[d], degc_sh[d] = pack_shard_block(
+            d, colstart, dstT, degc_all, bounds, b_max, q_max, n)
     bounds_full = np.zeros(num_shards + 1, np.int64)
     bounds_full[:len(bounds)] = bounds
     bounds_full[len(bounds):] = n
@@ -346,6 +364,11 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
     n = sh["n"]
     b_max = sh["b_max"]
     cap_n = _next_pow2(max(n, 2))
+    if jax.process_count() > 1 and cap_n != n:
+        raise NotImplementedError(
+            "multihost sharded BFS requires a power-of-two vertex count "
+            "(the frontier pad would mix global and process-local "
+            "arrays); pad the snapshot to the next power of two")
     dev = sh.get("_dev")
     if dev is None:
         # upload once and cache — re-uploading ~9GB of edge shards per
@@ -373,12 +396,25 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
     # exchange
     from titan_tpu.utils.jitcache import dev_scalar
 
-    dist = jnp.full((n + 1,), INF, jnp.int32).at[source_dense].set(0)
-    frontier = pad(jnp.full((1,), source_dense, jnp.int32))
     f_count = 1
     m8_f = int(np.asarray(degc[source_dense]))
     m8_unvis = total_chunks - m8_f
-    st_dev = jnp.asarray([1, m8_f, m8_unvis, 0], jnp.int32)
+    if jax.process_count() > 1:
+        # multihost: initial state must be GLOBAL (replicated) arrays —
+        # a process-local jnp array cannot feed a process-spanning jit
+        from titan_tpu.parallel.multihost import host_replicated
+        d0 = np.full((n + 1,), INF, np.int32)
+        d0[source_dense] = 0
+        dist = host_replicated(mesh, d0)
+        fr0 = np.full((cap_n,), n, np.int32)
+        fr0[0] = source_dense
+        frontier = host_replicated(mesh, fr0)
+        st_dev = host_replicated(
+            mesh, np.asarray([1, m8_f, m8_unvis, 0], np.int32))
+    else:
+        dist = jnp.full((n + 1,), INF, jnp.int32).at[source_dense].set(0)
+        frontier = pad(jnp.full((1,), source_dense, jnp.int32))
+        st_dev = jnp.asarray([1, m8_f, m8_unvis, 0], jnp.int32)
     level = 0
     found_guess = 4
     LAST_EXCHANGE_CAPS.clear()

@@ -1,0 +1,203 @@
+"""Multi-host (DCN) execution: a process-spanning device mesh with
+host-sharded graph loading.
+
+SURVEY §2.8 names the JAX distributed runtime across hosts as the
+rebuild's cross-host data plane (the reference distributes OLAP across
+machines through Hadoop InputFormats —
+titan-hadoop-core/.../scan/HadoopScanMapper.java:33); this module is the
+TPU-native seam: every host calls :func:`init` (jax.distributed), all
+hosts run the SAME program over a :func:`global_mesh` spanning every
+process's devices, and graph arrays are materialized with
+:func:`host_sharded` / :func:`host_replicated` so each host only ever
+touches the shards its own devices hold (host-sharded snapshot loading —
+a scale-26 graph never exists whole on any single host).
+
+Single-controller semantics still hold per JAX's multi-controller model:
+jit/shard_map calls must be issued by every process in lockstep, and
+scalar readbacks of REPLICATED outputs are process-local. The sharded
+BFS host loop (models/bfs_hybrid_sharded) is deterministic given the
+stats vector, so every host takes identical branches.
+
+Driven by ``__graft_entry__.dryrun_multihost`` (2 processes x 4 virtual
+CPU devices) and tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+
+def init(coordinator: str, num_processes: int, process_id: int) -> None:
+    """Join the cross-host runtime (call ONCE per process, before any
+    jax computation). ``coordinator`` is host:port of process 0; local
+    device count comes from the platform (on CPU, set
+    ``--xla_force_host_platform_device_count``)."""
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def global_mesh(axis: str = "v"):
+    """A 1D mesh over EVERY device of EVERY process (DCN-spanning)."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), (axis,))
+
+
+def host_sharded(mesh, shape, dtype, fill: Callable[[int], np.ndarray],
+                 axis: str = "v"):
+    """A global array sharded along dim 0 of ``shape``, materialized
+    host-locally: ``fill(block_index)`` is called ONLY for blocks whose
+    owning device is addressable from this process — the host-sharded
+    loading seam (no host holds the whole array)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P(axis, *([None] * (len(shape) - 1)))
+    sharding = NamedSharding(mesh, spec)
+    ndev = mesh.devices.size
+    if shape[0] % ndev:
+        raise ValueError(f"dim0 {shape[0]} must divide over {ndev} devices")
+    block = shape[0] // ndev
+
+    def cb(index):
+        # index is a tuple of slices into the global shape
+        lo = index[0].start or 0
+        return np.ascontiguousarray(fill(lo // block))
+
+    return jax.make_array_from_callback(tuple(shape), sharding, cb)
+
+
+def host_replicated(mesh, value: np.ndarray):
+    """A fully-replicated global array (every host provides the same
+    data for its local devices)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P())
+    return jax.make_array_from_callback(value.shape, sharding,
+                                        lambda idx: value[idx])
+
+
+def run_multihost_bfs(host_graph: dict, source_dense: int, mesh,
+                      max_levels: int = 1000):
+    """The sharded hybrid BFS over a process-spanning mesh with
+    HOST-SHARDED loading: each process builds and uploads only the
+    padded shard blocks its own devices hold (a production loader feeds
+    the same ``fill`` callbacks from its key-range of the distributed
+    scan tier). Every process must call this with identical arguments;
+    returns (dist np [n], levels) on every process.
+
+    ``host_graph``: the graph500-style host dict
+    (n / q_total / deg / colstart / dstT numpy arrays)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from titan_tpu.models import bfs_hybrid_sharded as S
+    from titan_tpu.utils.jitcache import set_scalar_sharding
+
+    num = int(mesh.devices.size)
+    n = host_graph["n"]
+    deg = np.asarray(host_graph["deg"])
+    dstT = np.asarray(host_graph["dstT"])
+    degc_all = (-(-deg // 8)).astype(np.int32)
+    colstart = np.zeros(n + 1, np.int64)
+    np.cumsum(degc_all, out=colstart[1:])
+    bounds, b_max, q_max = S.plan_shard_cuts(colstart, n, num)
+    d_eff = len(bounds) - 1
+    bounds_full = np.zeros(num + 1, np.int64)
+    bounds_full[:len(bounds)] = bounds
+    bounds_full[len(bounds):] = n
+
+    # one shared block-packing definition with the single-host path
+    # (S.pack_shard_block), so the layouts cannot drift
+    def fill(part):
+        def f(d):
+            return S.pack_shard_block(d, colstart, dstT, degc_all,
+                                      bounds_full, b_max, q_max,
+                                      n)[part][None]
+        return f
+
+    dstT_sh = host_sharded(mesh, (num, 8, q_max), np.int32, fill(0))
+    colstart_sh = host_sharded(mesh, (num, b_max + 1), np.int32, fill(1))
+    degc_sh = host_sharded(mesh, (num, b_max), np.int32, fill(2))
+    lo_sh = host_sharded(mesh, (num,), np.int32,
+                         lambda d: bounds_full[d:d + 1].astype(np.int32))
+    hi_sh = host_sharded(
+        mesh, (num,), np.int32,
+        lambda d: bounds_full[d + 1:d + 2].astype(np.int32))
+    degc_rep = host_replicated(
+        mesh, np.concatenate([degc_all, [0]]).astype(np.int32))
+    total = int(colstart[n])
+    sh = {
+        "bounds": bounds_full, "n": n, "b_max": b_max, "q_max": q_max,
+        "q_total": host_graph["q_total"], "total_chunks": total,
+        "degc": np.concatenate([degc_all, [0]]).astype(np.int32),
+        "shard_chunks": [int(colstart[bounds_full[d + 1]]
+                             - colstart[bounds_full[d]])
+                         for d in range(d_eff)],
+        "_dev": (dstT_sh, colstart_sh, degc_sh, degc_rep, lo_sh, hi_sh),
+    }
+    host_graph["_shards"] = (num, sh)
+    set_scalar_sharding(NamedSharding(mesh, P()))
+    try:
+        dist, levels = S.frontier_bfs_hybrid_sharded(
+            host_graph, source_dense, mesh, max_levels=max_levels)
+        return np.asarray(dist), levels
+    finally:
+        set_scalar_sharding(None)
+
+
+def _worker(coordinator: str, num_processes: int, process_id: int,
+            scale: int) -> None:
+    """One process of the multihost dryrun (spawned by
+    ``__graft_entry__.dryrun_multihost``): joins the distributed
+    runtime, builds the SAME symmetric R-MAT graph as every peer, runs
+    the host-sharded BFS over the process-spanning mesh, and process 0
+    validates bit-equality against the single-chip hybrid."""
+    import json
+
+    init(coordinator, num_processes, process_id)
+    import jax
+
+    from titan_tpu.models.bfs_hybrid import (build_chunked_csr,
+                                             frontier_bfs_hybrid)
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    from titan_tpu.olap.tpu.rmat import rmat_edges
+
+    src_e, dst_e = rmat_edges(scale, 16, seed=2)
+    snap = snap_mod.from_arrays(1 << scale,
+                                np.concatenate([src_e, dst_e]),
+                                np.concatenate([dst_e, src_e]))
+    g = build_chunked_csr(snap)
+    hg = {"n": snap.n, "q_total": g["q_total"],
+          "deg": np.asarray(snap.out_degree),
+          "colstart": g["_host"]["colstart"],
+          "dstT": g["_host"]["dstT"]}
+    source = int(np.argmax(snap.out_degree))
+    mesh = global_mesh()
+    dist, levels = run_multihost_bfs(hg, source, mesh)
+    if process_id == 0:
+        ref, _ = frontier_bfs_hybrid(snap, source)
+        ok = bool((dist == np.asarray(ref)).all())
+        print("MULTIHOST_OK " + json.dumps({
+            "processes": num_processes,
+            "devices": jax.device_count(),
+            "local_devices": jax.local_device_count(),
+            "scale": scale, "levels": levels,
+            "reached": int((dist < (1 << 30)).sum()),
+            "bit_equal_vs_single_chip": ok}), flush=True)
+        if not ok:
+            raise SystemExit(2)
+
+
+if __name__ == "__main__":
+    import sys
+
+    _worker(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+            int(sys.argv[4]) if len(sys.argv) > 4 else 13)

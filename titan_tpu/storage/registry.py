@@ -71,7 +71,13 @@ def _remote_cluster(hostname=None, port=None, replication=None,
                                             else float(read_repair)))
 
 
+def _gdbm(directory=None, **kw):
+    from titan_tpu.storage.gdbmkv import GdbmStoreManager
+    return GdbmStoreManager(directory)
+
+
 register_store("inmemory", _inmemory)
 register_store("sqlite", _sqlite)
+register_store("gdbm", _gdbm)
 register_store("remote", _remote)
 register_store("remote-cluster", _remote_cluster)

@@ -36,6 +36,17 @@ def jit_once(key: str, builder: Callable):
 # each distinct value is shipped ONCE per process.
 
 _SCALARS: dict = {}
+_SCALAR_SHARDING = None
+
+
+def set_scalar_sharding(sharding) -> None:
+    """Multihost mode: materialize pooled scalars as GLOBAL (replicated)
+    arrays under ``sharding`` — process-local device scalars cannot feed
+    a process-spanning jit. Pass None to return to single-process mode.
+    Clears the pool (existing entries carry the old placement)."""
+    global _SCALAR_SHARDING
+    _SCALAR_SHARDING = sharding
+    _SCALARS.clear()
 
 
 def dev_scalar(value, dtype: str = "int32"):
@@ -43,7 +54,15 @@ def dev_scalar(value, dtype: str = "int32"):
     key = (dtype, value)
     got = _SCALARS.get(key)
     if got is None:
+        import numpy as np
+
+        import jax
         import jax.numpy as jnp
-        got = jnp.asarray(value, dtype=getattr(jnp, dtype))
+        if _SCALAR_SHARDING is not None:
+            arr = np.asarray(value, dtype=dtype)
+            got = jax.make_array_from_callback(
+                (), _SCALAR_SHARDING, lambda idx: arr)
+        else:
+            got = jnp.asarray(value, dtype=getattr(jnp, dtype))
         _SCALARS[key] = got
     return got
