@@ -329,11 +329,9 @@ def test_hint_overflow_forces_merged_reads_until_full_sync(nodes,
     """Spilled hints may include tombstones: merged reads stay forced
     (reconnect alone must not clear the taint) until compact_tombstones
     runs a full anti-entropy pass, which also delivers the missed data."""
-    import titan_tpu.storage.cluster as C
-    monkeypatch.setattr(C, "MAX_HINTS_PER_PEER", 1)
     mgr = ClusterStoreManager(hosts_of(nodes), replication=3,
                               write_consistency="quorum", virtual_nodes=16,
-                              read_repair=0.0)
+                              read_repair=0.0, max_hints_per_peer=1)
     store = mgr.open_database("s")
     txh = mgr.begin_transaction()
     store.mutate(b"seed", [Entry(b"c", b"0")], [], txh)

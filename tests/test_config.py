@@ -114,3 +114,60 @@ def test_default_tree_is_wellformed():
     assert defaults.MAX_PARTITIONS.validate(64) == 64
     with pytest.raises(ValueError):
         defaults.MAX_PARTITIONS.validate(48)  # not a power of two
+
+
+def test_tuning_options_wire_through():
+    """The r4 tuning options actually govern their subsystems (not just
+    docgen entries): query.traversal-batch bounds the multiQuery width,
+    query.barrier-size bounds the bulking barrier."""
+    import titan_tpu
+    g = titan_tpu.open({"storage.backend": "inmemory",
+                        "query.traversal-batch": 3,
+                        "query.barrier-size": 7})
+    try:
+        tx = g.new_transaction()
+        vs = [tx.add_vertex("n") for _ in range(10)]
+        for i in range(9):
+            vs[i].add_edge("link", vs[i + 1])
+        tx.commit()
+        calls = []
+        tx_cls = type(g.new_transaction())
+        real = tx_cls.multi_vertex_edges
+
+        def counting(self, vids, *a, **kw):
+            calls.append(len(vids))
+            return real(self, vids, *a, **kw)
+
+        tx_cls.multi_vertex_edges = counting
+        try:
+            n = g.traversal().V().out("link").count().next()
+        finally:
+            tx_cls.multi_vertex_edges = real
+        assert n == 9
+        assert calls and max(calls) <= 3      # traversal-batch honored
+    finally:
+        g.close()
+
+
+def test_scan_options_wire_through(tmp_path):
+    import titan_tpu
+    from titan_tpu.storage.scan import StandardScanner
+    g = titan_tpu.open({"storage.backend": "inmemory",
+                        "storage.scan.threads": 2,
+                        "storage.scan.queue-size": 16,
+                        "storage.scan.block-size": 5})
+    try:
+        from titan_tpu.config import defaults as d
+        assert g.config.get(d.SCAN_THREADS) == 2
+        tx = g.new_transaction()
+        for i in range(6):
+            tx.add_vertex("n", name=f"x{i}")
+        tx.commit()
+        # ghost-removal job runs a scan through the configured knobs
+        from titan_tpu.olap.jobs import GhostVertexRemover
+        metrics = StandardScanner(
+            g.backend.edge_store.store, g.backend.manager).execute(
+            GhostVertexRemover(g), graph=g)
+        assert metrics is not None
+    finally:
+        g.close()

@@ -97,6 +97,22 @@ CLUSTER_READ_REPAIR = ConfigOption(
     "chance per read of a full-replica merge + write-back of stale cells "
     "under write-consistency=all (quorum/one always merge-read)",
     float, 0.1, Mutability.MASKABLE, lambda v: 0.0 <= v <= 1.0)
+CLUSTER_MAX_HINTS = ConfigOption(
+    CLUSTER_NS, "max-hints-per-peer",
+    "hinted-handoff queue cap per down peer; overflow converges via "
+    "merged reads + the next anti-entropy pass", int, 50_000,
+    Mutability.MASKABLE, positive)
+
+SCAN_NS = ConfigNamespace(STORAGE_NS, "scan", "backend scan framework")
+SCAN_THREADS = ConfigOption(
+    SCAN_NS, "threads", "processor threads per scan job", int, 4,
+    Mutability.MASKABLE, positive)
+SCAN_QUEUE_SIZE = ConfigOption(
+    SCAN_NS, "queue-size", "bounded row-queue capacity between the data "
+    "puller and the processors", int, 1024, Mutability.MASKABLE, positive)
+SCAN_BLOCK_SIZE = ConfigOption(
+    SCAN_NS, "block-size", "rows per processor progress block", int, 1000,
+    Mutability.MASKABLE, positive)
 
 LOCK_NS = ConfigNamespace(STORAGE_NS, "lock", "distributed locking")
 LOCK_RETRIES = ConfigOption(LOCK_NS, "retries", "lock-claim write retries",
@@ -229,6 +245,16 @@ FAST_PROPERTY = ConfigOption(
     QUERY_NS, "fast-property",
     "prefetch all properties on first single-property access",
     bool, True, Mutability.MASKABLE)
+TRAVERSAL_BATCH = ConfigOption(
+    QUERY_NS, "traversal-batch",
+    "vertices per batched multi-vertex adjacency fetch in the traversal "
+    "engine (the multiQuery batch width)", int, 512,
+    Mutability.MASKABLE, positive)
+BARRIER_SIZE = ConfigOption(
+    QUERY_NS, "barrier-size",
+    "bulking-barrier chunk — TP3 LazyBarrierStrategy's max barrier size "
+    "(bounds how much laziness a barrier may consume)", int, 2500,
+    Mutability.MASKABLE, positive)
 
 # --- metrics ----------------------------------------------------------------
 METRICS_NS = ConfigNamespace(ROOT, "metrics", "metrics collection")

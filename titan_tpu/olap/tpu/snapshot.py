@@ -45,6 +45,10 @@ class GraphSnapshot:
     edge_values: dict = field(default_factory=dict)  # name -> [E] array
     labels: Optional[np.ndarray] = None              # [E] int32 label codes
     label_names: dict = field(default_factory=dict)  # code -> label name
+    # name -> (values object-array [n], present bool [n]) — dense vertex
+    # property columns for the device-compiled traversal subset
+    # (attach_vertex_values / olap_compile has()/values() steps)
+    vertex_values: dict = field(default_factory=dict)
     # freshness contract (see refresh()): epoch is graph.mutation_epoch at
     # build/refresh time; build() subscribes an in-process change listener
     epoch: int = 0
@@ -278,6 +282,41 @@ class GraphSnapshot:
                      "_dev_frontier"):
             if hasattr(self, attr):
                 delattr(self, attr)
+
+    def attach_vertex_values(self, graph, keys) -> None:
+        """Build dense vertex property columns through the OLTP tx (one
+        batched pass; SINGLE-cardinality keys only) and cache them for
+        the device-compiled traversal subset. Keys already attached are
+        skipped; unknown keys attach as all-absent columns."""
+        from titan_tpu.core.defs import Cardinality
+
+        want = [k for k in keys if k not in self.vertex_values]
+        if not want:
+            return
+        for k in want:
+            st = graph.schema.get_by_name(k)
+            if st is not None and \
+                    graph.schema.cardinality(st.id) is not Cardinality.SINGLE:
+                raise ValueError(
+                    f"attach_vertex_values: key {k!r} is not "
+                    "SINGLE-cardinality; multi-valued columns have no "
+                    "dense representation")
+        tx = graph.new_transaction(read_only=True)
+        try:
+            cols = {k: (np.empty(self.n, object), np.zeros(self.n, bool))
+                    for k in want}
+            for i in range(self.n):
+                v = tx.vertex(int(self.vertex_ids[i]))
+                if v is None:
+                    continue
+                for k in want:
+                    val = v.value(k)
+                    if val is not None:
+                        cols[k][0][i] = val
+                        cols[k][1][i] = True
+        finally:
+            tx.rollback()
+        self.vertex_values.update(cols)
 
     def dense_of(self, vertex_id: int) -> int:
         i = int(np.searchsorted(self.vertex_ids, vertex_id))

@@ -51,7 +51,8 @@ class Backend:
                 replication=config.get(d.CLUSTER_REPLICATION),
                 write_consistency=config.get(d.CLUSTER_WRITE_CONSISTENCY),
                 virtual_nodes=config.get(d.CLUSTER_VNODES),
-                read_repair=config.get(d.CLUSTER_READ_REPAIR))
+                read_repair=config.get(d.CLUSTER_READ_REPAIR),
+                max_hints_per_peer=config.get(d.CLUSTER_MAX_HINTS))
         # metrics wrapping sits directly over the raw manager so every opened
         # store is instrumented, and the expiration cache layers ABOVE it —
         # cache hits don't count as backend ops (reference: Backend.java:142-146)

@@ -398,7 +398,9 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
     def __init__(self, hosts: Sequence[str], port: int = 8283,
                  replication: int = 1, write_consistency: str = "all",
                  virtual_nodes: int = 64, timeout: float = 30.0,
-                 read_repair: float = 0.1):
+                 read_repair: float = 0.1,
+                 max_hints_per_peer: int = MAX_HINTS_PER_PEER):
+        self._max_hints = max_hints_per_peer
         if not hosts:
             raise ValueError("remote-cluster needs storage.hostname entries")
         self._peer_ids = []
@@ -528,7 +530,7 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
                     mut: KCVMutation) -> None:
         with self._hints_lock:
             q = self._hints.setdefault(p, [])
-            if len(q) >= MAX_HINTS_PER_PEER:
+            if len(q) >= self._max_hints:
                 # spilled hints converge later via forced merged reads +
                 # the next full anti-entropy pass
                 self._ever_overflowed.add(p)

@@ -59,8 +59,9 @@ def _remote(hostname=None, port=None, **kw):
 
 def _remote_cluster(hostname=None, port=None, replication=None,
                     write_consistency=None, virtual_nodes=None,
-                    read_repair=None, **kw):
-    from titan_tpu.storage.cluster import ClusterStoreManager
+                    read_repair=None, max_hints_per_peer=None, **kw):
+    from titan_tpu.storage.cluster import (MAX_HINTS_PER_PEER,
+                                           ClusterStoreManager)
     hosts = hostname if isinstance(hostname, (list, tuple)) \
         else ([hostname] if hostname else [])
     return ClusterStoreManager(list(hosts), int(port or 8283),
@@ -68,7 +69,10 @@ def _remote_cluster(hostname=None, port=None, replication=None,
                                write_consistency or "all",
                                int(virtual_nodes or 64),
                                read_repair=(0.1 if read_repair is None
-                                            else float(read_repair)))
+                                            else float(read_repair)),
+                               max_hints_per_peer=int(
+                                   max_hints_per_peer
+                                   or MAX_HINTS_PER_PEER))
 
 
 def _gdbm(directory=None, **kw):

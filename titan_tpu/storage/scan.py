@@ -31,12 +31,22 @@ class StandardScanner:
         self._manager = manager
 
     def execute(self, job: ScanJob, graph=None, config: Optional[dict] = None,
-                num_threads: int = 4, queue_size: int = 1024,
-                block_size: int = 1000,
+                num_threads: Optional[int] = None,
+                queue_size: Optional[int] = None,
+                block_size: Optional[int] = None,
                 key_range: Optional[tuple] = None) -> ScanMetrics:
         """``key_range=(start, end)`` restricts the scan to one key split —
         the distributed runner's unit of work (reference: HadoopScanMapper
-        processing one input split)."""
+        processing one input split). Unset tuning params come from the
+        graph's ``storage.scan.*`` options when a graph is supplied."""
+        if graph is not None and hasattr(graph, "config"):
+            from titan_tpu.config import defaults as d
+            num_threads = num_threads or graph.config.get(d.SCAN_THREADS)
+            queue_size = queue_size or graph.config.get(d.SCAN_QUEUE_SIZE)
+            block_size = block_size or graph.config.get(d.SCAN_BLOCK_SIZE)
+        num_threads = num_threads or 4
+        queue_size = queue_size or 1024
+        block_size = block_size or 1000
         metrics = ScanMetrics()
         job.setup(graph, config or {}, metrics)
         queries = list(job.get_queries())

@@ -665,17 +665,32 @@ class Traversal:
             return (ok, lk, sk, pk)
         return (ok, lk, sk)
 
-    @classmethod
-    def _barrier(cls, traversers) -> Iterator[Traverser]:
+    def _opt_int(self, option, default: int) -> int:
+        """Tuning option from the source graph's config (query.* knobs);
+        ``default`` for detached traversals."""
+        g = getattr(self.source, "graph", None) \
+            if self.source is not None else None
+        if g is not None:
+            from titan_tpu.config import defaults as d
+            got = g.config.get(getattr(d, option))
+            if got:
+                return int(got)
+        return default
+
+    def _barrier(self, traversers) -> Iterator[Traverser]:
         """LazyBarrierStrategy analog: merge traversers with equal
         location into one with summed bulk — within bounded chunks of
-        ``_BARRIER_CHUNK`` (TP3 inserts ``NoOpBarrierStep(2500)``, not an
-        unbounded drain), so ``g.V().out().limit(1)`` stays lazy instead
-        of expanding the whole frontier before limit() can cut it."""
+        ``query.barrier-size`` (TP3 inserts ``NoOpBarrierStep(2500)``,
+        not an unbounded drain), so ``g.V().out().limit(1)`` stays lazy
+        instead of expanding the whole frontier before limit() can cut
+        it."""
+        cls = type(self)   # _merge_key is a classmethod helper
+        chunk = self._opt_int("BARRIER_SIZE", _BARRIER_CHUNK)
+
         def gen():
             it = iter(traversers)
             while True:
-                batch = list(itertools.islice(it, _BARRIER_CHUNK))
+                batch = list(itertools.islice(it, chunk))
                 if not batch:
                     return
                 merged: dict = {}
@@ -1323,11 +1338,13 @@ class Traversal:
         without materializing neighbor traversers."""
         labels = list(labels) or None
 
+        nbatch = self._opt_int("TRAVERSAL_BATCH", _BATCH)
+
         def gen():
             total = 0
             it = iter(traversers)
             while True:
-                batch = list(itertools.islice(it, _BATCH))
+                batch = list(itertools.islice(it, nbatch))
                 if not batch:
                     break
                 vids = [t.obj.id for t in batch]
@@ -1348,10 +1365,12 @@ class Traversal:
     def _vertex_step(self, tx, traversers, direction, labels, kind):
         labels = list(labels) or None
 
+        nbatch = self._opt_int("TRAVERSAL_BATCH", _BATCH)
+
         def gen():
             it = iter(traversers)
             while True:
-                batch = list(itertools.islice(it, _BATCH))
+                batch = list(itertools.islice(it, nbatch))
                 if not batch:
                     return
                 vids = [t.obj.id for t in batch]

@@ -8,12 +8,17 @@ Here a supported subset compiles all the way down to CSR supersteps on the
 device instead: the traverser multiset becomes a dense count vector c in
 N^n, and every out()/in()/both() step is one masked segment-sum over the
 edge list (c'[w] = sum of c[v] over edges v→w) — Gremlin bulking semantics
-exactly, since counts carry path multiplicity. dedup() collapses counts to
-an indicator; count()/sum of the final vector are device reductions.
+exactly, since counts carry path multiplicity. Mid-chain ``has(key, P)``
+filters multiply the count vector by a dense vertex-property mask
+(snapshot.attach_vertex_values columns, built once and cached); dedup()
+collapses counts to an indicator; the terminal reductions — count(),
+values(k).sum()/mean(), groupCount()[.by(k)] — read the final count
+vector against the property columns.
 
-Supported chains: V([ids]) [has/hasLabel/hasId...] then
-out/in/both(labels) | repeat(out...).times(k) | dedup, terminated by
-count() | id() | dedup() | nothing (vertex list). Anything else returns
+Supported chains: V([ids]) [has/hasLabel/hasId...] then any mix of
+out/in/both(labels) | repeat(out...).times(k) | dedup | has(key, P),
+terminated by count() | id() | dedup() | values(k)[.sum()|.mean()] |
+groupCount()[.by(k)] | nothing (vertex list). Anything else returns
 None and the OLTP interpreter runs instead (SURVEY §7 "hard parts" #1:
 compile a useful subset, fall back to host execution otherwise).
 """
@@ -35,11 +40,16 @@ class FallbackToInterpreter(Exception):
 
 
 class CompiledTraversal:
-    def __init__(self, source, start, vsteps, terminal, dedup_start=False):
+    def __init__(self, source, start, ops, terminal, dedup_start=False):
         self.source = source
         self.start = start          # ("all",) | ("ids", ids) | ("query", conds)
-        self.vsteps = vsteps        # [(direction, label_names|None, dedup?)]
-        self.terminal = terminal    # "count" | "id" | "vertices"
+        # ops: ("expand", direction, label_names|None, dedup?)
+        #    | ("filter", key, pred)
+        self.ops = ops
+        # terminal: "count" | "id" | "vertices" | ("values", k)
+        #         | ("values_sum", k) | ("values_mean", k)
+        #         | ("groupCount", key|None)
+        self.terminal = terminal
         self.dedup_start = dedup_start
 
     # -- execution -----------------------------------------------------------
@@ -51,7 +61,8 @@ class CompiledTraversal:
             # label codes without a code→name map are just as unanswerable
             # for a name-filtered step — don't silently match nothing
             not snap.label_names)
-        if no_codes and any(labels for _, labels, _ in self.vsteps):
+        if no_codes and any(op[0] == "expand" and op[2]
+                            for op in self.ops):
             if explicit:
                 # a user-supplied snapshot IS the dataset; answering from the
                 # live graph instead would silently switch datasets
@@ -66,13 +77,54 @@ class CompiledTraversal:
         if self.dedup_start:
             np.minimum(counts0, 1, out=counts0)
         plan = []
-        for direction, labels, dedup_after in self.vsteps:
-            mask = self._label_mask(snap, labels)
-            plan.append((direction, mask, dedup_after))
+        for op in self.ops:
+            if op[0] == "expand":
+                _, direction, labels, dedup_after = op
+                plan.append(("e", direction,
+                             self._label_mask(snap, labels), dedup_after))
+            else:
+                _, key, pred = op
+                vals, present = self._vertex_column(snap, key)
+                plan.append(("f", _pred_mask(vals, present, pred)))
         final = _execute_plan(snap, counts0, plan)
+        return self._terminal(snap, final)
+
+    def _terminal(self, snap, final: np.ndarray) -> Iterator:
         from titan_tpu.traversal.dsl import Traverser
         if self.terminal == "count":
             return iter([Traverser(int(final.sum()))])
+        term = self.terminal
+        if isinstance(term, tuple) and term[0] in ("values", "values_sum",
+                                                   "values_mean"):
+            vals, present = self._vertex_column(snap, term[1])
+            live = np.flatnonzero((final > 0) & present)
+            if term[0] == "values":
+                return iter([Traverser(vals[di], bulk=int(final[di]))
+                             for di in live])
+            bulks = final[live].astype(np.int64)
+            try:
+                numeric = np.array([float(v) for v in vals[live]])
+            except (TypeError, ValueError) as e:
+                raise FallbackToInterpreter(
+                    f"non-numeric values for {term[1]!r}") from e
+            total = float(numeric @ bulks)
+            if term[0] == "values_sum":
+                return iter([Traverser(total)])
+            nb = int(bulks.sum())
+            return iter([Traverser(total / nb)] if nb else [])
+        if isinstance(term, tuple) and term[0] == "groupCount":
+            by = term[1]
+            out: dict = {}
+            if by is None:
+                # interpreter parity: vertices group by their element id
+                for di in np.flatnonzero(final):
+                    out[int(snap.vertex_ids[di])] = int(final[di])
+            else:
+                vals, present = self._vertex_column(snap, by)
+                for di in np.flatnonzero((final > 0) & present):
+                    k = vals[di]
+                    out[k] = out.get(k, 0) + int(final[di])
+            return iter([Traverser(out)])
         nonzero = np.flatnonzero(np.asarray(final))
         if self.terminal == "id":
             out = []
@@ -91,6 +143,21 @@ class CompiledTraversal:
             snap = snap_mod.build(self.source.graph)
             self.source._snapshot = snap
         return snap
+
+    def _vertex_column(self, snap, key: str):
+        got = snap.vertex_values.get(key)
+        if got is None:
+            graph = getattr(self.source, "graph", None)
+            if graph is None:
+                raise FallbackToInterpreter(
+                    f"snapshot carries no vertex column for {key!r} and "
+                    "no source graph to build it from")
+            try:
+                snap.attach_vertex_values(graph, [key])
+            except ValueError as e:       # e.g. non-SINGLE cardinality
+                raise FallbackToInterpreter(str(e)) from e
+            got = snap.vertex_values[key]
+        return got
 
     def _start_counts(self, snap) -> np.ndarray:
         counts = np.zeros(snap.n, dtype=np.int32)
@@ -125,10 +192,44 @@ class CompiledTraversal:
         return np.isin(snap.labels, np.array(sorted(wanted), dtype=np.int32))
 
 
+# P ops with a straight numpy vectorization (fast path; anything else
+# evaluates the predicate per present value)
+_NUMPY_PREDS = {
+    "eq": lambda a, v: a == v,
+    "neq": lambda a, v: a != v,
+    "lt": lambda a, v: a < v,
+    "lte": lambda a, v: a <= v,
+    "gt": lambda a, v: a > v,
+    "gte": lambda a, v: a >= v,
+}
+
+
+def _pred_mask(vals: np.ndarray, present: np.ndarray, pred) -> np.ndarray:
+    """Dense [n] bool mask: pred holds on the vertex's value (absent ->
+    False — has() semantics)."""
+    from titan_tpu.query.predicates import P
+
+    mask = np.zeros(len(present), dtype=bool)
+    idx = np.flatnonzero(present)
+    if not len(idx):
+        return mask
+    if isinstance(pred, P) and pred.op in _NUMPY_PREDS:
+        try:
+            arr = np.array([v for v in vals[idx]])
+            with np.errstate(invalid="ignore"):
+                mask[idx] = _NUMPY_PREDS[pred.op](arr, pred.value)
+            return mask
+        except (TypeError, ValueError):
+            pass        # mixed/odd types: per-value path below
+    mask[idx] = [bool(pred(v)) for v in vals[idx]]
+    return mask
+
+
 @functools.lru_cache(maxsize=64)
 def _step_fn(n: int, plan_sig: tuple):
     """Jitted superstep chain for a given (n, per-step shape) signature.
-    plan_sig: ((direction, has_mask, dedup), ...) — masks are traced args."""
+    plan_sig entries: ("e", direction, has_label_mask, dedup) |
+    ("f",) — label/filter masks are traced args."""
     import jax
     import jax.numpy as jnp
 
@@ -136,7 +237,13 @@ def _step_fn(n: int, plan_sig: tuple):
 
     def fn(counts, src, dst, masks):
         mi = 0
-        for direction, has_mask, dedup_after in plan_sig:
+        for entry in plan_sig:
+            if entry[0] == "f":
+                vmask = masks[mi]
+                mi += 1
+                counts = jnp.where(vmask, counts, 0)
+                continue
+            _, direction, has_mask, dedup_after = entry
             mask = None
             if has_mask:
                 mask = masks[mi]
@@ -166,9 +273,18 @@ def _execute_plan(snap, counts0: np.ndarray, plan) -> np.ndarray:
 
     if not plan:
         return counts0
-    masks = [m for _, m, _ in plan if m is not None]
-    plan_sig = tuple((d, m is not None, dd) for d, m, dd in plan)
-    fn = _step_fn(snap.n, plan_sig)
+    masks = []
+    sig = []
+    for entry in plan:
+        if entry[0] == "f":
+            masks.append(entry[1])
+            sig.append(("f",))
+        else:
+            _, d, m, dd = entry
+            if m is not None:
+                masks.append(m)
+            sig.append(("e", d, m is not None, dd))
+    fn = _step_fn(snap.n, tuple(sig))
     out = fn(jnp.asarray(counts0), jnp.asarray(snap.src),
              jnp.asarray(snap.dst), tuple(jnp.asarray(m) for m in masks))
     return np.asarray(out)
@@ -198,16 +314,18 @@ def try_compile(steps: list, source) -> Optional[CompiledTraversal]:
         start = ("query", conds)
         i += 1
 
-    vsteps = []
+    ops: list = []
     terminal = "vertices"
     dedup_start = False
+    expands = 0
     while i < len(steps):
-        name, args = steps[i]
+        name, args = steps[i][0], steps[i][1]
         if name == "vstep":
             direction, labels, kind = args
             if kind != "vertex":
                 return None
-            vsteps.append([direction, labels or None, False])
+            ops.append(["expand", direction, labels or None, False])
+            expands += 1
             i += 1
         elif name == "repeat" and i + 1 < len(steps) and \
                 steps[i + 1][0] == "times":
@@ -216,14 +334,26 @@ def try_compile(steps: list, source) -> Optional[CompiledTraversal]:
             for sname, sargs in sub._steps:
                 if sname != "vstep" or sargs[2] != "vertex":
                     return None
-                sub_steps.append([sargs[0], sargs[1] or None, False])
-            vsteps.extend(s[:] for _ in range(times) for s in sub_steps)
+                sub_steps.append(["expand", sargs[0], sargs[1] or None,
+                                  False])
+            ops.extend(s[:] for _ in range(times) for s in sub_steps)
+            expands += times * len(sub_steps)
             i += 2
+        elif name == "has" and expands > 0:
+            # mid-chain vertex-property filter (device mask); pseudo-keys
+            # need the streaming filters
+            key, pred = args
+            if key in ("id", "label"):
+                return None
+            ops.append(["filter", key, pred])
+            i += 1
         elif name == "dedup":
-            if vsteps:
-                vsteps[-1][2] = True
-            else:
+            if ops and ops[-1][0] == "expand":
+                ops[-1][3] = True
+            elif not ops:
                 dedup_start = True
+            else:
+                return None    # dedup directly after a filter: rare shape
             i += 1
         elif name == "count":
             if i != len(steps) - 1:
@@ -235,10 +365,39 @@ def try_compile(steps: list, source) -> Optional[CompiledTraversal]:
                 return None
             terminal = "id"
             i += 1
+        elif name == "values":
+            keys = args[0]
+            if len(keys) != 1:
+                return None
+            rest = [s[0] for s in steps[i + 1:]]
+            if rest == []:
+                terminal = ("values", keys[0])
+            elif rest == ["sum"]:
+                terminal = ("values_sum", keys[0])
+            elif rest == ["mean"]:
+                terminal = ("values_mean", keys[0])
+            else:
+                return None
+            i = len(steps)
+        elif name == "groupCount":
+            by = args[0] if args else None
+            j = i + 1
+            if j < len(steps) and steps[j][0] == "by":
+                spec = steps[j][1][0]
+                if not isinstance(spec, str):
+                    return None
+                by = spec
+                j += 1
+            if j != len(steps):
+                return None
+            if by is not None and not isinstance(by, str):
+                return None
+            terminal = ("groupCount", by)
+            i = len(steps)
         else:
             return None
-    if not vsteps and terminal == "vertices":
+    if not ops and terminal == "vertices":
         return None   # no device work: let the interpreter answer
-    return CompiledTraversal(source, start,
-                             [tuple(s) for s in vsteps], terminal,
-                             dedup_start=dedup_start)
+    return CompiledTraversal(
+        source, start,
+        [tuple(s) for s in ops], terminal, dedup_start=dedup_start)
