@@ -46,8 +46,9 @@ _EST = {
     "gods_2hop": 20,
     "ldbc": 120,
     "bfs23": 250,        # 1.2GB upload + runs
-    "bfs23_sharded": 360,  # shard upload + 2 sharded runs (~121s each
-                           # on 1 device — see the stage note) + plain
+    "bfs23_sharded": 600,  # shard upload + per-cap-bucket kernel
+                           # compiles (~540s cold, cached after) +
+                           # 2 sharded runs (~5s each) + plain
     "bfs26": 900,        # 9GB upload (430-830s slow-day) + 3 reps x ~14s
     "ssspwcc": 300,      # delta-stepping SSSP + BFS-seeded WCC (r4)
     "pagerank": 250,     # 0.6GB upload + 12 iterations
@@ -268,14 +269,12 @@ def bfs_sharded_overhead(rep: Report, scale: int) -> None:
         "plain_seconds": round(t_1c, 3),
         "overhead_pct": round(100.0 * (t_sh / t_1c - 1.0), 1),
         "note": (
-            "honest gap, diagnosed (PERF_NOTES r4): the sharded "
-            "bottom-up fuses its chunk rounds + exhaust sweep in ONE "
-            "static-shape kernel sized at pow2(q_max), so on a "
-            "1-device mesh it pays full-graph-width sweeps every "
-            "level; the single-chip hybrid sizes those from per-level "
-            "readbacks. The exchange/distribution machinery itself is "
-            "O(frontier) (see the dryrun COMM_PROFILE). Round-5 item: "
-            "host-driven shapes for the sharded bottom-up.")}
+            "sharded bottom-up is host-driven (bu0/bu_more/exhaust at "
+            "per-chip cap buckets — r4 rewrite; the old fused "
+            "full-width kernel measured 121s here). Remaining overhead "
+            "= the per-level exchange dispatch + replicated-dist "
+            "merge, which amortizes over real multi-chip meshes; "
+            "exchange volume is O(frontier) (dryrun COMM_PROFILE).")}
     # free the shard replica before the scale-26 upload
     hg.pop("_shards", None)
     rep.emit()

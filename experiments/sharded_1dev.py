@@ -1,0 +1,72 @@
+"""Measure sharded-BFS-on-1-device vs plain hybrid at a given scale on
+the real chip (the bench's bfs_s{N}_sharded_1dev stage, standalone).
+
+Round-4 context: the fused full-width bottom-up measured 121s vs 2.3s
+plain at scale 23; the host-driven cap-bucket rewrite should bring the
+sharded path to parity + exchange overhead.
+
+Usage: python experiments/sharded_1dev.py [scale]
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def main(scale=23):
+    import jax
+
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+    from titan_tpu.models.bfs_hybrid_sharded import (
+        LAST_PROFILE, frontier_bfs_hybrid_sharded)
+    from titan_tpu.olap.tpu import graph500
+    from titan_tpu.parallel.mesh import vertex_mesh
+
+    t0 = time.time()
+    hg = graph500.load_or_build(scale, 16, seed=2, verbose=True)
+    print(f"build/load {time.time() - t0:.1f}s", flush=True)
+    t0 = time.time()
+    g = graph500.to_device(hg)
+    jax.block_until_ready(g["dstT"])
+    print(f"upload {time.time() - t0:.1f}s", flush=True)
+    deg = np.asarray(hg["deg"])
+    source = int(np.flatnonzero(deg > 0)[0])
+    mesh = vertex_mesh(1)
+
+    # plain hybrid: warm-up + timed
+    d, _ = frontier_bfs_hybrid(g, source, return_device=True)
+    _ = int(np.asarray(d[0]))
+    best = float("inf")
+    for _i in range(2):
+        t0 = time.time()
+        d, lv = frontier_bfs_hybrid(g, source, return_device=True)
+        _ = int(np.asarray(d[0]))
+        best = min(best, time.time() - t0)
+    print(f"plain hybrid: {best:.3f}s ({lv} levels)", flush=True)
+    d_ref = d
+
+    # sharded on 1 device: warm-up (uploads shard replica) + timed
+    t0 = time.time()
+    d, _ = frontier_bfs_hybrid_sharded(hg, source, mesh,
+                                       return_device=True)
+    _ = int(np.asarray(d[0]))
+    print(f"sharded first (upload+compile): {time.time() - t0:.1f}s",
+          flush=True)
+    best_sh = float("inf")
+    for _i in range(2):
+        t0 = time.time()
+        d, lv_sh = frontier_bfs_hybrid_sharded(hg, source, mesh,
+                                               return_device=True)
+        _ = int(np.asarray(d[0]))
+        best_sh = min(best_sh, time.time() - t0)
+    print(f"sharded 1dev: {best_sh:.3f}s ({lv_sh} levels) "
+          f"overhead {100 * (best_sh / best - 1):.1f}%", flush=True)
+    for p in LAST_PROFILE:
+        print(p, flush=True)
+    same = bool((np.asarray(d[:1 << scale]) ==
+                 np.asarray(d_ref[:1 << scale])).all())
+    print(f"bit_equal={same}", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 23)

@@ -242,3 +242,168 @@ def test_ldbc_is3_shape_on_device(gp):
     t = build(src)
     steps = Traversal._fold_has_into_start(list(t._steps))
     assert try_compile(steps, src) is not None
+
+
+def test_refresh_invalidates_property_columns(gp):
+    """Advisor r4 finding: the dense vertex-property columns must not
+    survive a refresh() that applied a property mutation — a stale
+    column silently mis-answers compiled has()/values()."""
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    from titan_tpu.query.predicates import P
+
+    snap = snap_mod.build(gp)
+    build = lambda t: t.V().out("knows") \
+        .has("age", P.gt(25)).count()                 # noqa: E731
+    src = gp.traversal().with_computer("tpu", snapshot=snap)
+    assert build(src).to_list() == build(gp.traversal()).to_list()
+
+    # flip every matching vertex across the predicate boundary
+    tx = gp.new_transaction()
+    for v in list(tx.vertices()):
+        if (v.value("age") or 0) > 25:
+            v.property("age", 0)
+    tx.commit()
+    snap.refresh()
+    # drop the thread-bound tx: its slice caches legitimately hold the
+    # pre-commit ages (repeatable read) — we want a fresh-read baseline
+    gp.tx().rollback()
+    after_oltp = build(gp.traversal()).to_list()
+    after_tpu = build(
+        gp.traversal().with_computer("tpu", snapshot=snap)).to_list()
+    assert after_tpu == after_oltp == [0]
+
+
+def test_refresh_vertex_add_keeps_columns_consistent(gp):
+    """Vertex-set changes must drop the property columns (a stale
+    column of the old length crashes the jitted filter plan)."""
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    from titan_tpu.query.predicates import P
+
+    snap = snap_mod.build(gp)
+    build = lambda t: t.V().out("knows") \
+        .has("age", P.gt(25)).count()                 # noqa: E731
+    src = gp.traversal().with_computer("tpu", snapshot=snap)
+    build(src).to_list()                    # attaches the age column
+
+    tx = gp.new_transaction()
+    nv = tx.add_vertex("person", name="new", age=48)
+    old = next(iter(tx.vertices()))
+    nv.add_edge("knows", old)
+    old.add_edge("knows", nv)
+    tx.commit()
+    snap.refresh()
+    gp.tx().rollback()
+    after_oltp = build(gp.traversal()).to_list()
+    after_tpu = build(
+        gp.traversal().with_computer("tpu", snapshot=snap)).to_list()
+    assert after_tpu == after_oltp
+
+
+def test_compiled_empty_sum_matches_interpreter(gp):
+    """TP3 empty reducing barrier: sum of an empty stream emits NOTHING
+    on the compiled path too (tests/test_tp3_differential pins the
+    interpreter side)."""
+    from titan_tpu.query.predicates import P
+    build = lambda t: t.V().out("knows") \
+        .has("age", P.gt(10 ** 6)).values("age").sum_()   # noqa: E731
+    oltp, tpu = _assert_both(gp, build)
+    assert oltp == tpu == []
+
+
+def test_stale_explicit_snapshot_refuses_live_column_build(gp):
+    """A property column must NOT be lazily built from a live graph
+    that moved past an explicit snapshot's epoch (dataset mixing —
+    mirrors the label-code guard in run())."""
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    from titan_tpu.query.predicates import P
+
+    snap = snap_mod.build(gp)
+    tx = gp.new_transaction()
+    next(iter(tx.vertices())).property("age", 1)
+    tx.commit()                      # snapshot now stale, NOT refreshed
+    assert snap.stale
+    with pytest.raises(ValueError, match="stale"):
+        (gp.traversal().with_computer("tpu", snapshot=snap)
+         .V().out("knows").has("age", P.gt(25)).count().to_list())
+    # refresh heals it
+    snap.refresh()
+    got = (gp.traversal().with_computer("tpu", snapshot=snap)
+           .V().out("knows").has("age", P.gt(25)).count().to_list())
+    gp.tx().rollback()
+    assert got == gp.traversal().V().out("knows") \
+        .has("age", P.gt(25)).count().to_list()
+
+
+def test_group_count_pseudo_and_missing_keys(gp):
+    """Advisor r4: by('id') must match the interpreter (element-id
+    buckets), by('label') must fall back (not silently answer {}), and
+    vertices missing the key group under None, not dropped."""
+    oltp, tpu = _assert_both(
+        gp, lambda t: t.V().out("knows").group_count("id"))
+    assert oltp == tpu and tpu != [{}]
+    oltp, tpu = _assert_both(
+        gp, lambda t: t.V().out("knows").group_count("label"))
+    assert oltp == tpu                       # interpreter fallback
+    # partially-populated key: gp has no 'nickname' anywhere
+    oltp, tpu = _assert_both(
+        gp, lambda t: t.V().out("knows").group_count("nickname"))
+    assert oltp == tpu
+    assert list(tpu[0].keys()) == [None]
+
+
+def test_stale_auto_snapshot_falls_back(gp):
+    """A STALE auto-built snapshot must fall back to the interpreter
+    for property columns — only a user-supplied snapshot raises."""
+    from titan_tpu.query.predicates import P
+    src = gp.traversal().with_computer("tpu")
+    src.V().out().count().to_list()          # builds + caches auto snap
+    tx = gp.new_transaction()
+    next(iter(tx.vertices())).property("age", 1)
+    tx.commit()
+    gp.tx().rollback()
+    got = src.V().out("knows").has("age", P.gt(25)).count().to_list()
+    assert got == gp.traversal().V().out("knows") \
+        .has("age", P.gt(25)).count().to_list()
+
+
+def test_unbound_snapshot_refuses_column_build(gp):
+    """from_arrays snapshots have no epoch binding to any graph —
+    lazily building property columns from the live graph could mix
+    datasets undetectably, so the compiled path must refuse."""
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    from titan_tpu.query.predicates import P
+
+    full = snap_mod.build(gp)
+    unbound = snap_mod.from_arrays(full.n, full.src, full.dst,
+                                   full.vertex_ids)
+    with pytest.raises(ValueError, match="not bound"):
+        (gp.traversal().with_computer("tpu", snapshot=unbound)
+         .V().out().has("age", P.gt(25)).count().to_list())
+    # explicit attach by the user is the sanctioned path
+    unbound.attach_vertex_values(gp, ["age"])
+    got = (gp.traversal().with_computer("tpu", snapshot=unbound)
+           .V().out().has("age", P.gt(25)).count().to_list())
+    assert got == gp.traversal().V().out() \
+        .has("age", P.gt(25)).count().to_list()
+
+
+def test_edge_only_refresh_keeps_property_columns(gp):
+    """Edge-only delta merges keep the dense property columns (their
+    vertex alignment is unchanged) — no full re-attach per refresh."""
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    from titan_tpu.query.predicates import P
+
+    snap = snap_mod.build(gp)
+    build = lambda t: t.V().out("knows") \
+        .has("age", P.gt(25)).count()                 # noqa: E731
+    build(gp.traversal().with_computer("tpu", snapshot=snap)).to_list()
+    assert "age" in snap.vertex_values
+    tx = gp.new_transaction()
+    vs = list(tx.vertices())
+    vs[0].add_edge("knows", vs[1])
+    tx.commit()
+    snap.refresh()
+    assert "age" in snap.vertex_values    # survived the edge-only merge
+    gp.tx().rollback()
+    assert build(gp.traversal().with_computer("tpu", snapshot=snap)) \
+        .to_list() == build(gp.traversal()).to_list()

@@ -21,11 +21,25 @@ lists over ICI:
   previous level's exchange. Only the level-end found lists are
   gathered.
 
-The host drives levels like the single-chip hybrid (shapes bucketed to
-powers of two, two scalar readbacks per level). Per-shard edge arrays
-use LOCAL column indices, so each shard stays int32-safe as long as its
-own chunk count is < 2^31 — 8 shards of a scale-26 graph are ~35M
-columns each.
+The host drives levels AND the bottom-up sub-steps exactly like the
+single-chip hybrid: bu0 (candidate build + chunk-0 check) / bu_more
+(fused chunk rounds over the compacted survivors) / bu_exhaust (masked
+sweep of the stragglers), each dispatched at a power-of-two cap bucket
+sized from the PER-CHIP maxima read back in the stats vectors. The
+round-4 bench measured why this matters: the previous single fused
+bottom-up kernel ran every chunk round at full block width (c_cap =
+pow2(b_max)) and the exhaust at the full shard span (p_cap =
+pow2(q_max)), and a kernel pays its full cap in dead lanes — 121s vs
+2.3s for the plain hybrid at scale 23 on one device (PERF_NOTES.md
+round 4). With host-driven shrinking caps the sharded path costs the
+same kernel widths as the single-chip hybrid plus the O(frontier)
+exchange. The fused full-width kernel is kept only for multi-process
+(DCN) meshes, where host-side eager slicing of global arrays is not
+available.
+
+Per-shard edge arrays use LOCAL column indices, so each shard stays
+int32-safe as long as its own chunk count is < 2^31 — 8 shards of a
+scale-26 graph are ~35M columns each.
 
 Symmetric graphs only (see bfs_hybrid). Validated against the
 single-chip hybrid on an 8-device CPU mesh in tests/test_sharded_bfs.py.
@@ -44,14 +58,18 @@ from titan_tpu.utils.jitcache import jit_once
 
 ALPHA = 8.0
 BU_CHUNK_ROUNDS = 8
-BU_FUSE = 4
+
+# stats vector layout (the exchange's replicated output; the first four
+# entries predate the per-chip cap stats)
+ST_NF, ST_M8F, ST_M8UNVIS, ST_FOUNDMAX, ST_M8F_CHIP, ST_NUNV_CHIP = range(6)
 
 # instrumentation: found_cap used by each level's exchange in the most
 # recent run (tests assert the exchange stays sparse)
 LAST_EXCHANGE_CAPS: list = []
 # full per-level communication profile of the most recent run: mode,
-# frontier size, per-chip found max, exchange cap/volume, retries
-# (MULTICHIP_r04 evidence — the dryrun prints it)
+# frontier size, per-chip found max, exchange cap/volume, retries, and
+# (bottom-up) the host-driven sub-dispatch cap trail
+# (MULTICHIP evidence — the dryrun prints it)
 LAST_PROFILE: list = []
 
 
@@ -80,6 +98,17 @@ def plan_shard_cuts(colstart: np.ndarray, n: int, num_shards: int):
             f"use more shards than {num_shards} (local column indices "
             "are int32)")
     return bounds, b_max, q_max
+
+
+def shard_unvisited_cap(degc_all: np.ndarray, bounds) -> int:
+    """Max over shards of the count of expandable (degc>0) block
+    vertices — the size bound for the FIRST bottom-up level's per-chip
+    candidate list, before any exchange stats exist. The ONLY definition
+    (single-host shard_chunked_csr and the multihost host-sharded loader
+    both call it, so the bu0 c_cap guarantee cannot drift)."""
+    counts = [int((degc_all[int(bounds[d]):int(bounds[d + 1])] > 0).sum())
+              for d in range(len(bounds) - 1)]
+    return max(counts, default=1) or 1
 
 
 def pack_shard_block(d: int, colstart: np.ndarray, dstT: np.ndarray,
@@ -164,6 +193,7 @@ def shard_chunked_csr(snap_or_graph, num_shards: int):
         # these should be near-uniform)
         "shard_chunks": [int(colstart[bounds[d + 1]] - colstart[bounds[d]])
                          for d in range(d_eff)],
+        "nunv_chip_max": shard_unvisited_cap(degc_all, bounds),
     }
     if isinstance(g, dict):
         g["_shards"] = (num_shards, out)
@@ -222,15 +252,22 @@ def _exchange():
         from titan_tpu.parallel.mesh import VERTEX_AXIS
 
         @functools.partial(
-            jax.jit, static_argnames=("mesh", "found_cap", "n_"))
-        def ex(dist_sh, level, degc, mesh, found_cap: int, n_: int):
+            jax.jit, static_argnames=("mesh", "found_cap", "n_", "b_max"))
+        def ex(dist_sh, level, degc, degc_sh, lo_sh, hi_sh, mesh,
+               found_cap: int, n_: int, b_max: int):
             """Merge per-chip discoveries: all-gather each chip's newly-
             found ids and apply to every replica; returns merged dist
             (replicated) + stats + the new frontier list. ``found_cap``
             is DEVICE-CHECKED: stats carry the true per-chip found max,
             and the host retries with a bigger cap on overflow (the
-            merged result is then discarded) — no pre-sizing readback."""
-            def per_shard(dist, degc):
+            merged result is then discarded) — no pre-sizing readback.
+            The stats also carry the PER-CHIP maxima that size the next
+            level's kernel caps (frontier chunk mass owned by one chip;
+            unvisited expandable vertices in one block) so dead-lane
+            width never exceeds one chip's actual share."""
+            def per_shard(dist, degc, degc_l, lo, hi):
+                degc_l = degc_l[0]
+                lo, hi = lo[0], hi[0]
                 newly = dist[0][:n_] == level + 1
                 cnt = newly.sum().astype(jnp.int32)
                 found_max = jax.lax.pmax(cnt, VERTEX_AXIS)
@@ -241,26 +278,55 @@ def _exchange():
                     level + 1, mode="drop")
                 changed = merged[:n_] == level + 1
                 nf = changed.sum().astype(jnp.int32)
-                frontier = jnp.nonzero(
-                    changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
                 m8_f = jnp.where(changed, degc[:n_], 0) \
                     .sum(dtype=jnp.int32)
                 unvis = merged[:n_] >= INF
                 m8_unvis = jnp.where(unvis, degc[:n_], 0) \
                     .sum(dtype=jnp.int32)
-                return merged, frontier, jnp.stack(
-                    [nf, m8_f, m8_unvis, found_max])
+                # per-chip cap stats over this chip's block window
+                blk = jnp.minimum(
+                    lo + jnp.arange(b_max, dtype=jnp.int32), n_)
+                bmask = jnp.arange(b_max, dtype=jnp.int32) < (hi - lo)
+                vis_blk = merged[blk]
+                m8f_chip = jnp.where(
+                    bmask & (vis_blk == level + 1), degc_l, 0) \
+                    .sum(dtype=jnp.int32)
+                nunv_chip = (bmask & (vis_blk >= INF) & (degc_l > 0)) \
+                    .sum().astype(jnp.int32)
+                m8f_chip = jax.lax.pmax(m8f_chip, VERTEX_AXIS)
+                nunv_chip = jax.lax.pmax(nunv_chip, VERTEX_AXIS)
+                return merged, jnp.stack(
+                    [nf, m8_f, m8_unvis, found_max, m8f_chip, nunv_chip])
 
             return jax.shard_map(
                 per_shard, mesh=mesh,
-                in_specs=(P(VERTEX_AXIS, None), P()),
-                out_specs=(P(), P(), P()), check_vma=False,
-            )(dist_sh, degc)
+                in_specs=(P(VERTEX_AXIS, None), P(), P(VERTEX_AXIS, None),
+                          P(VERTEX_AXIS), P(VERTEX_AXIS)),
+                out_specs=(P(), P()), check_vma=False,
+            )(dist_sh, degc, degc_sh, lo_sh, hi_sh)
         return ex
     return jit_once("shbfs_exchange", build)
 
 
-def _bu_level():
+def _frontier_of_sh():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_",))
+        def fr(dist, level, n_: int):
+            """Frontier list of ``dist == level`` — built lazily ONLY
+            before a top-down level (bottom-up levels never consume a
+            frontier list, and the n-scale nonzero was the exchange's
+            single biggest per-level cost on bu-heavy runs)."""
+            changed = dist[:n_] == level
+            return jnp.nonzero(
+                changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
+        return fr
+    return jit_once("shbfs_frontier_of", build)
+
+
+def _bu_fused():
     def build():
         import jax
         import jax.numpy as jnp
@@ -275,11 +341,12 @@ def _bu_level():
         def bu(dist, level, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh,
                mesh, c_cap: int, p_cap: int, n_: int, b_max: int,
                rounds: int):
-            """One FULLY-LOCAL bottom-up level: each chip scans its own
-            unvisited block vertices against the previous level's
-            (exchange-settled) dist. Chunk rounds with early exit, then
-            an exhaustive sweep for stragglers, all inside one call.
-            Returns per-chip dist + per-chip found counts."""
+            """One FULLY-LOCAL bottom-up level in a single dispatch:
+            chunk rounds with early exit, then an exhaustive sweep for
+            stragglers, all at FULL block/shard width. Multi-process
+            (DCN) fallback only — the host-driven bu0/bu_more/bu_exhaust
+            path below is strictly cheaper but slices device arrays
+            eagerly, which needs addressable (single-process) arrays."""
             def per_shard(dist, dstT_l, cs_l, degc_l, lo, hi):
                 dstT_l, cs_l, degc_l = dstT_l[0], cs_l[0], degc_l[0]
                 lo, hi = lo[0], hi[0]
@@ -351,6 +418,210 @@ def _bu_level():
     return jit_once("shbfs_bu", build)
 
 
+def _bu_start_sh():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from titan_tpu.parallel.mesh import VERTEX_AXIS
+
+        @functools.partial(
+            jax.jit, static_argnames=("mesh", "c_cap", "n_", "b_max"))
+        def bu0(dist, level, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh,
+                mesh, c_cap: int, n_: int, b_max: int):
+            """Bottom-up level opener (host-driven path): per-shard
+            candidate build from the block window + chunk-0 bitmap test,
+            survivors compacted under lax.cond (skipped at heavy levels
+            where chunk 0 decides everyone — the single-chip hybrid
+            measured the unconditional compaction at ~2.5s). Returns
+            per-chip (dist, fbits, cand, off, prog=[nc, rem8]).
+            Caller guarantee: per-chip candidate count <= c_cap (sized
+            from the exchange's nunv_chip pmax)."""
+            def per_shard(dist, dstT_l, cs_l, degc_l, lo, hi):
+                dstT_l, cs_l, degc_l = dstT_l[0], cs_l[0], degc_l[0]
+                lo, hi = lo[0], hi[0]
+                q_pad = dstT_l.shape[1] - 1
+                fbits = _pack_bits(dist, level, n_)
+                block = jnp.arange(b_max, dtype=jnp.int32)
+                cand_mask = (block < hi - lo) \
+                    & (dist[jnp.minimum(block + lo, n_)] >= INF) \
+                    & (degc_l > 0)
+                cand = jnp.nonzero(cand_mask, size=c_cap,
+                                   fill_value=b_max)[0].astype(jnp.int32)
+                c_count = cand_mask.sum().astype(jnp.int32)
+                alive = jnp.arange(c_cap) < c_count
+                lv = jnp.clip(cand, 0, b_max - 1)
+                cols = jnp.where(alive, cs_l[lv], q_pad)
+                parents = jnp.take(dstT_l, jnp.clip(cols, 0, q_pad),
+                                   axis=1)
+                hit = _bit_of(fbits, parents)
+                found = alive & hit.any(axis=0)
+                dist = dist.at[jnp.where(found, lv + lo, n_ + 1)].set(
+                    level + 1, mode="drop")
+                surv = alive & ~found & (degc_l[lv] > 1)
+                nc = surv.sum().astype(jnp.int32)
+
+                def compact(_):
+                    idx = jnp.nonzero(surv, size=c_cap,
+                                      fill_value=c_cap - 1)[0]
+                    keep = jnp.arange(c_cap) < nc
+                    cand2 = jnp.where(keep, cand[idx], b_max) \
+                        .astype(jnp.int32)
+                    off2 = jnp.where(keep, 1, 0).astype(jnp.int32)
+                    rem8 = jnp.where(surv, degc_l[lv] - 1, 0) \
+                        .sum(dtype=jnp.int32)
+                    return cand2, off2, rem8
+
+                def no_compact(_):
+                    return (jnp.full((c_cap,), b_max, jnp.int32),
+                            jnp.zeros((c_cap,), jnp.int32), jnp.int32(0))
+
+                cand2, off2, rem8 = jax.lax.cond(
+                    nc > 0, compact, no_compact, None)
+                return (dist[None], fbits[None], cand2[None], off2[None],
+                        jnp.stack([nc, rem8])[None])
+
+            return jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P(), P(VERTEX_AXIS, None, None),
+                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
+                          P(VERTEX_AXIS), P(VERTEX_AXIS)),
+                out_specs=(P(VERTEX_AXIS, None),) * 5, check_vma=False,
+            )(dist, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
+        return bu0
+    return jit_once("shbfs_bu0", build)
+
+
+def _bu_more_sh():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from titan_tpu.parallel.mesh import VERTEX_AXIS
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("mesh", "c_cap", "n_", "b_max", "fuse"),
+            donate_argnums=(0,))
+        def bu(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, level,
+               colstart_sh, degc_sh, lo_sh, dstT_sh, mesh, c_cap: int,
+               n_: int, b_max: int, fuse: int):
+            """``fuse`` chunk-check rounds over the per-chip compacted
+            survivor lists; survivor count arrives in each chip's row of
+            the DEVICE prog vector (no scalar put)."""
+            def per_shard(dist, fbits, cand, off, prog, cs_l, degc_l,
+                          lo, dstT_l):
+                dist, fbits, cand, off, prog = (
+                    dist[0], fbits[0], cand[0], off[0], prog[0])
+                cs_l, degc_l, lo, dstT_l = (
+                    cs_l[0], degc_l[0], lo[0], dstT_l[0])
+                q_pad = dstT_l.shape[1] - 1
+                c_count = prog[0]
+
+                def round_(state, _):
+                    dist, cand, off, c_count = state
+                    alive = jnp.arange(c_cap) < c_count
+                    lv = jnp.clip(cand, 0, b_max - 1)
+                    cols = jnp.where(alive, cs_l[lv] + off, q_pad)
+                    parents = jnp.take(dstT_l, jnp.clip(cols, 0, q_pad),
+                                       axis=1)
+                    hit = _bit_of(fbits, parents)
+                    found = alive & hit.any(axis=0)
+                    dist = dist.at[jnp.where(found, lv + lo, n_ + 1)] \
+                        .set(level + 1, mode="drop")
+                    surv = alive & ~found & (off + 1 < degc_l[lv])
+                    idx = jnp.nonzero(surv, size=c_cap,
+                                      fill_value=c_cap - 1)[0]
+                    nc = surv.sum().astype(jnp.int32)
+                    keep = jnp.arange(c_cap) < nc
+                    cand = jnp.where(keep, cand[idx], b_max)
+                    off = jnp.where(keep, off[idx] + 1, 0)
+                    return (dist, cand, off, nc), None
+
+                (dist, cand, off, c_count), _ = jax.lax.scan(
+                    round_, (dist, cand, off, c_count), None,
+                    length=fuse)
+                alive = jnp.arange(c_cap) < c_count
+                lv = jnp.clip(cand, 0, b_max - 1)
+                rem = jnp.where(alive,
+                                jnp.maximum(degc_l[lv] - off, 0), 0) \
+                    .sum(dtype=jnp.int32)
+                return (dist[None], cand[None], off[None],
+                        jnp.stack([c_count, rem])[None])
+
+            return jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
+                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
+                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
+                          P(VERTEX_AXIS, None), P(VERTEX_AXIS),
+                          P(VERTEX_AXIS, None, None)),
+                out_specs=(P(VERTEX_AXIS, None),) * 4, check_vma=False,
+            )(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, colstart_sh,
+              degc_sh, lo_sh, dstT_sh)
+        return bu
+    return jit_once("shbfs_bu_more", build)
+
+
+def _bu_exhaust_sh():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from titan_tpu.parallel.mesh import VERTEX_AXIS
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("mesh", "c_cap", "p_cap", "n_", "b_max"),
+            donate_argnums=(0,))
+        def ex(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, level,
+               colstart_sh, degc_sh, lo_sh, dstT_sh, mesh, c_cap: int,
+               p_cap: int, n_: int, b_max: int):
+            """Masked sweep over ALL remaining chunks of each chip's
+            surviving candidates (p_cap sized from the per-chip rem8
+            max, not the shard span)."""
+            def per_shard(dist, fbits, cand, off, prog, cs_l, degc_l,
+                          lo, dstT_l):
+                dist, fbits, cand, off, prog = (
+                    dist[0], fbits[0], cand[0], off[0], prog[0])
+                cs_l, degc_l, lo, dstT_l = (
+                    cs_l[0], degc_l[0], lo[0], dstT_l[0])
+                q_pad = dstT_l.shape[1] - 1
+                c_count = prog[0]
+                valid = jnp.arange(c_cap) < c_count
+                lv = jnp.clip(cand, 0, b_max - 1)
+                rem = jnp.maximum(degc_l[lv] - off, 0)
+                cols, p_total, owner = enumerate_chunk_pairs(
+                    valid, rem, cs_l[lv] + off, p_cap, q_pad,
+                    with_owner=True)
+                parents = jnp.take(dstT_l, cols, axis=1)
+                hit = _bit_of(fbits, parents).any(axis=0)
+                j = jnp.arange(p_cap, dtype=jnp.int32)
+                found_per = jnp.zeros((c_cap,), jnp.int32) \
+                    .at[jnp.where(j < p_total, owner, c_cap - 1)] \
+                    .max(hit.astype(jnp.int32), mode="drop")
+                found = valid & (found_per > 0)
+                dist = dist.at[jnp.where(found, lv + lo, n_ + 1)].set(
+                    level + 1, mode="drop")
+                return dist[None]
+
+            return jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
+                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
+                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
+                          P(VERTEX_AXIS, None), P(VERTEX_AXIS),
+                          P(VERTEX_AXIS, None, None)),
+                out_specs=P(VERTEX_AXIS, None), check_vma=False,
+            )(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, colstart_sh,
+              degc_sh, lo_sh, dstT_sh)
+        return ex
+    return jit_once("shbfs_bu_ex", build)
+
+
 def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
                                 max_levels: int = 1000,
                                 return_device: bool = False):
@@ -364,7 +635,8 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
     n = sh["n"]
     b_max = sh["b_max"]
     cap_n = _next_pow2(max(n, 2))
-    if jax.process_count() > 1 and cap_n != n:
+    multiproc = jax.process_count() > 1
+    if multiproc and cap_n != n:
         raise NotImplementedError(
             "multihost sharded BFS requires a power-of-two vertex count "
             "(the frontier pad would mix global and process-local "
@@ -381,9 +653,11 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
         sh["_dev"] = dev
     dstT_sh, colstart_sh, degc_sh, degc, lo_sh, hi_sh = dev
     total_chunks = sh["total_chunks"]
+    cap_b = _next_pow2(max(b_max, 2))
+    cap_q = _next_pow2(max(sh["q_max"], 2))
     td = _td_expand()
     ex = _exchange()
-    bu = _bu_level()
+    fr_of = _frontier_of_sh()
 
     def pad(a):
         if a.shape[0] < cap_n:
@@ -399,7 +673,11 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
     f_count = 1
     m8_f = int(np.asarray(degc[source_dense]))
     m8_unvis = total_chunks - m8_f
-    if jax.process_count() > 1:
+    nunv_chip = sh["nunv_chip_max"]
+    m8f_chip = m8_f
+    st0 = np.asarray([1, m8_f, m8_unvis, 0, m8f_chip, nunv_chip],
+                     np.int32)
+    if multiproc:
         # multihost: initial state must be GLOBAL (replicated) arrays —
         # a process-local jnp array cannot feed a process-spanning jit
         from titan_tpu.parallel.multihost import host_replicated
@@ -409,63 +687,108 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
         fr0 = np.full((cap_n,), n, np.int32)
         fr0[0] = source_dense
         frontier = host_replicated(mesh, fr0)
-        st_dev = host_replicated(
-            mesh, np.asarray([1, m8_f, m8_unvis, 0], np.int32))
+        st_dev = host_replicated(mesh, st0)
     else:
         dist = jnp.full((n + 1,), INF, jnp.int32).at[source_dense].set(0)
         frontier = pad(jnp.full((1,), source_dense, jnp.int32))
-        st_dev = jnp.asarray([1, m8_f, m8_unvis, 0], jnp.int32)
+        st_dev = jnp.asarray(st0)
     level = 0
-    found_guess = 4
+    # level-0 discoveries are bounded by the source's degree — seed the
+    # exchange cap from it instead of always paying an overflow retry
+    found_guess = min(_next_pow2(max(8 * m8_f, 4)), cap_n)
     LAST_EXCHANGE_CAPS.clear()
     LAST_PROFILE.clear()
     num_dev = int(mesh.devices.size)
     while f_count > 0 and level < max_levels:
         use_bu = m8_f * ALPHA > m8_unvis and f_count > 1
+        bu_trail: list = []
         if not use_bu:
             if m8_f == 0:
                 break
+            if frontier is None:
+                frontier = pad(fr_of(dist, dev_scalar(level), n_=n))
             f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
-            # p_cap covers the heaviest single shard's share; the frontier
-            # chunk total is a safe upper bound for every shard
-            p_cap = min(_next_pow2(max(m8_f, 2)),
-                        _next_pow2(max(total_chunks + n, 2)))
+            # p_cap covers the heaviest single chip's OWNED share of the
+            # frontier mass (each vertex expands on its owner only)
+            p_cap = min(_next_pow2(max(m8f_chip, 2)), cap_q)
             dist_sh = td(dist, frontier[:f_cap], st_dev,
                          dev_scalar(level), dstT_sh, colstart_sh,
                          degc_sh, lo_sh, hi_sh, mesh=mesh,
                          f_cap=f_cap, p_cap=p_cap, n_=n, b_max=b_max)
-        else:
-            c_cap = _next_pow2(max(b_max, 2))
-            p_cap = _next_pow2(max(sh["q_max"], 2))
+        elif multiproc:
+            # DCN fallback: one fused full-width dispatch (host-side
+            # eager slicing of global arrays is unavailable)
+            bu = _bu_fused()
             dist_sh = bu(dist, dev_scalar(level), dstT_sh,
                          colstart_sh, degc_sh, lo_sh, hi_sh,
-                         mesh=mesh, c_cap=c_cap, p_cap=p_cap, n_=n,
+                         mesh=mesh, c_cap=cap_b, p_cap=cap_q, n_=n,
                          b_max=b_max, rounds=BU_CHUNK_ROUNDS)
+        else:
+            # host-driven bottom-up: bu0 / fused bu_more rounds /
+            # exhaust, each at the per-chip cap bucket (see module doc)
+            bu0 = _bu_start_sh()
+            bu_more = _bu_more_sh()
+            bu_ex = _bu_exhaust_sh()
+            c_cap = min(_next_pow2(max(nunv_chip, 2)), cap_b)
+            dist_sh, fbits_sh, cand_sh, off_sh, prog_sh = bu0(
+                dist, dev_scalar(level), dstT_sh, colstart_sh, degc_sh,
+                lo_sh, hi_sh, mesh=mesh, c_cap=c_cap, n_=n, b_max=b_max)
+            prog = np.asarray(prog_sh)
+            nc_max = int(prog[:, 0].max())
+            rem8_max = int(prog[:, 1].max())
+            bu_trail.append({"step": "bu0", "c_cap": c_cap,
+                             "nc_max": nc_max})
+            if nc_max > 0:
+                # one fused dispatch covers the remaining chunk rounds
+                # (bu0 already consumed chunk 0) at the survivor cap
+                c2 = min(_next_pow2(max(nc_max, 2)), c_cap)
+                dist_sh, cand_sh, off_sh, prog_sh = bu_more(
+                    dist_sh, fbits_sh, cand_sh[:, :c2], off_sh[:, :c2],
+                    prog_sh, dev_scalar(level), colstart_sh, degc_sh,
+                    lo_sh, dstT_sh, mesh=mesh, c_cap=c2, n_=n,
+                    b_max=b_max, fuse=BU_CHUNK_ROUNDS - 1)
+                prog = np.asarray(prog_sh)
+                nc_max = int(prog[:, 0].max())
+                rem8_max = int(prog[:, 1].max())
+                bu_trail.append({"step": "bu_more", "c_cap": c2,
+                                 "fuse": BU_CHUNK_ROUNDS - 1,
+                                 "nc_max": nc_max})
+            if nc_max > 0:
+                c2 = min(_next_pow2(max(nc_max, 2)), c_cap)
+                p2 = min(_next_pow2(max(rem8_max, 2)), cap_q)
+                dist_sh = bu_ex(
+                    dist_sh, fbits_sh, cand_sh[:, :c2], off_sh[:, :c2],
+                    prog_sh, dev_scalar(level), colstart_sh, degc_sh,
+                    lo_sh, dstT_sh, mesh=mesh, c_cap=c2, p_cap=p2,
+                    n_=n, b_max=b_max)
+                bu_trail.append({"step": "bu_exhaust", "c_cap": c2,
+                                 "p_cap": p2})
         # device-sized exchange: dispatch with the adaptive guess cap and
-        # read ONE stats vector back (the only host sync of the level);
+        # read ONE stats vector back (the only host sync of a td level);
         # the stats carry the true per-chip found max, so an overflowed
         # merge is discarded and re-run with the exact cap (rare — the
         # guess tracks 4x the previous level's max)
         found_cap, retries = found_guess, 0
         while True:
-            dist_m, frontier, st = ex(dist_sh, dev_scalar(level), degc,
-                                      mesh=mesh, found_cap=found_cap,
-                                      n_=n)
-            f_count, m8_f, m8_unvis, found_max = \
-                (int(x) for x in np.asarray(st))
+            dist_m, st = ex(dist_sh, dev_scalar(level), degc,
+                            degc_sh, lo_sh, hi_sh, mesh=mesh,
+                            found_cap=found_cap, n_=n, b_max=b_max)
+            (f_count, m8_f, m8_unvis, found_max, m8f_chip,
+             nunv_chip) = (int(x) for x in np.asarray(st))
             if found_max <= found_cap:
                 break
             found_cap = _next_pow2(max(found_max, 2))
             retries += 1
         dist = dist_m
         st_dev = st
-        frontier = pad(frontier)
+        frontier = None
         LAST_EXCHANGE_CAPS.append(found_cap)
         LAST_PROFILE.append({
             "level": level, "mode": "bu" if use_bu else "td",
             "nf": f_count, "m8_f": m8_f,
             "found_max_per_chip": found_max, "found_cap": found_cap,
-            "exchanged_ids": num_dev * found_cap, "retries": retries})
+            "exchanged_ids": num_dev * found_cap, "retries": retries,
+            "bu_dispatches": len(bu_trail), "bu_trail": bu_trail})
         found_guess = min(_next_pow2(max(4 * found_max, 4)), cap_n)
         level += 1
     out = dist[0, :n] if dist.ndim == 2 else dist[:n]

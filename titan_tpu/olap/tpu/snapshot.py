@@ -147,7 +147,11 @@ class GraphSnapshot:
         removed_edges: list = []
         new_vids: set = set()
         dead_vids: set = set()
+        prop_keys: set = set()
         for p in payloads:
+            for r in (*p.get("added", ()), *p.get("removed", ())):
+                if "in" not in r:          # property mutation
+                    prop_keys.add(r.get("type"))
             for vid in p.get("added_vertices", ()):
                 new_vids.add(idm.canonical_vertex_id(vid))
             for vid in p.get("removed_vertices", ()):
@@ -177,11 +181,22 @@ class GraphSnapshot:
                  "removed_edges": len(removed_edges),
                  "added_vertices": len(new_vids),
                  "removed_vertices": len(dead_vids)}
+        # property mutations invalidate the dense vertex-property
+        # columns even when no edge/vertex changed (a stale column would
+        # silently mis-answer compiled has()/values() — pinned by
+        # tests/test_olap_compile.py)
+        for k in prop_keys:
+            self.vertex_values.pop(k, None)
         if not (add_src or removed_edges or new_vids or dead_vids):
             return stats
 
         self._invalidate_layout_caches()
         need_rebuild = bool(removed_edges or new_vids or dead_vids)
+        if need_rebuild:
+            # the vertex SET changes: every dense property column's
+            # length/alignment is invalidated (edge-only merges keep
+            # them — property mutations were already handled above)
+            self.vertex_values.clear()
         if not need_rebuild:
             self._merge_edges(np.asarray(add_src, np.int64),
                               np.asarray(add_dst, np.int64),
@@ -276,7 +291,10 @@ class GraphSnapshot:
 
     def _invalidate_layout_caches(self) -> None:
         """Drop every derived layout / device-array cache the model
-        kernels lazily attach (they rebuild from the refreshed arrays)."""
+        kernels lazily attach (they rebuild from the refreshed arrays).
+        The dense vertex-property columns are NOT cleared here — they
+        stay aligned across edge-only merges; apply_changes clears them
+        on property mutations (by key) and vertex-set changes (all)."""
         for attr in ("_out_csr", "_hybrid_csr", "_frontier_shards",
                      "_dev_frontier_sh", "_tiled_shards", "_dev_outdeg",
                      "_dev_frontier"):
@@ -305,15 +323,22 @@ class GraphSnapshot:
         try:
             cols = {k: (np.empty(self.n, object), np.zeros(self.n, bool))
                     for k in want}
-            for i in range(self.n):
-                v = tx.vertex(int(self.vertex_ids[i]))
-                if v is None:
-                    continue
-                for k in want:
-                    val = v.value(k)
-                    if val is not None:
-                        cols[k][0][i] = val
-                        cols[k][1][i] = True
+            # batched: one multi-row property-slice read per id chunk
+            # (tx.multi_vertex_properties), not n point reads — the
+            # first compiled has()/values() on an OLAP-scale snapshot
+            # must not pay minutes of host time
+            chunk = 4096
+            for c0 in range(0, self.n, chunk):
+                ids = [int(v) for v in self.vertex_ids[c0:c0 + chunk]]
+                got = tx.multi_vertex_properties(ids, keys=want)
+                for j, vid in enumerate(ids):
+                    props = got.get(vid)
+                    if not props:
+                        continue
+                    for k, val in props.items():
+                        if val is not None:
+                            cols[k][0][c0 + j] = val
+                            cols[k][1][c0 + j] = True
         finally:
             tx.rollback()
         self.vertex_values.update(cols)

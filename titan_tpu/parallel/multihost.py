@@ -141,6 +141,8 @@ def run_multihost_bfs(host_graph: dict, source_dense: int, mesh,
         "shard_chunks": [int(colstart[bounds_full[d + 1]]
                              - colstart[bounds_full[d]])
                          for d in range(d_eff)],
+        "nunv_chip_max": S.shard_unvisited_cap(degc_all,
+                                               bounds_full[:d_eff + 1]),
         "_dev": (dstT_sh, colstart_sh, degc_sh, degc_rep, lo_sh, hi_sh),
     }
     host_graph["_shards"] = (num, sh)

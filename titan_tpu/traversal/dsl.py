@@ -934,8 +934,13 @@ class Traversal:
         if name == "count":
             return iter([Traverser(sum(t.bulk for t in traversers))])
         if name == "sum":
-            return iter([Traverser(sum(t.obj * t.bulk
-                                       for t in traversers))])
+            # TP3: an empty reducing barrier emits NOTHING (only count
+            # emits 0) — pinned by tests/test_tp3_differential.py
+            tot, seen = 0, False
+            for t in traversers:
+                tot += t.obj * t.bulk
+                seen = True
+            return iter([Traverser(tot)] if seen else [])
         if name == "max":
             vals = [t.obj for t in traversers]
             return iter([Traverser(max(vals))] if vals else [])

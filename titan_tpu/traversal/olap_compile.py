@@ -55,8 +55,8 @@ class CompiledTraversal:
     # -- execution -----------------------------------------------------------
 
     def run(self) -> Iterator:
-        explicit = self.source._snapshot is not None
         snap = self._snapshot()
+        explicit = not getattr(snap, "_auto_built", False)
         no_codes = snap.labels is None or (
             # label codes without a code→name map are just as unanswerable
             # for a name-filtered step — don't silently match nothing
@@ -76,6 +76,19 @@ class CompiledTraversal:
         counts0 = self._start_counts(snap)
         if self.dedup_start:
             np.minimum(counts0, 1, out=counts0)
+        # attach every property column the plan needs in ONE batched
+        # pass (mid-chain filters + the terminal's key) — per-key
+        # attaches would re-scan the whole vertex table once per key
+        want = [op[1] for op in self.ops if op[0] != "expand"]
+        term = self.terminal
+        if isinstance(term, tuple) and term[1] is not None \
+                and term[0] in ("values", "values_sum", "values_mean",
+                                "groupCount"):
+            want.append(term[1])
+        missing = [k for k in dict.fromkeys(want)
+                   if k not in snap.vertex_values]
+        if missing:
+            self._attach_columns(snap, missing)
         plan = []
         for op in self.ops:
             if op[0] == "expand":
@@ -108,9 +121,11 @@ class CompiledTraversal:
                 raise FallbackToInterpreter(
                     f"non-numeric values for {term[1]!r}") from e
             total = float(numeric @ bulks)
-            if term[0] == "values_sum":
-                return iter([Traverser(total)])
             nb = int(bulks.sum())
+            if term[0] == "values_sum":
+                # TP3: an empty reducing barrier emits NOTHING (matches
+                # the interpreter's sum — tests/test_tp3_differential)
+                return iter([Traverser(total)] if nb else [])
             return iter([Traverser(total / nb)] if nb else [])
         if isinstance(term, tuple) and term[0] == "groupCount":
             by = term[1]
@@ -121,8 +136,11 @@ class CompiledTraversal:
                     out[int(snap.vertex_ids[di])] = int(final[di])
             else:
                 vals, present = self._vertex_column(snap, by)
-                for di in np.flatnonzero((final > 0) & present):
-                    k = vals[di]
+                for di in np.flatnonzero(final > 0):
+                    # interpreter parity: vertices missing the key group
+                    # under None (dsl._value_of returns None), they are
+                    # NOT dropped
+                    k = vals[di] if present[di] else None
                     out[k] = out.get(k, 0) + int(final[di])
             return iter([Traverser(out)])
         nonzero = np.flatnonzero(np.asarray(final))
@@ -141,21 +159,58 @@ class CompiledTraversal:
         if snap is None:
             from titan_tpu.olap.tpu import snapshot as snap_mod
             snap = snap_mod.build(self.source.graph)
+            # provenance tag: an auto-built snapshot may fall back to
+            # the interpreter when stale; a user-supplied one IS the
+            # dataset and must raise instead (once cached on the source
+            # the two are otherwise indistinguishable)
+            snap._auto_built = True
             self.source._snapshot = snap
         return snap
+
+    def _attach_columns(self, snap, keys: list) -> None:
+        """Build the missing dense property columns — one batched pass
+        for ALL keys — with the dataset-consistency guard: a column can
+        only be built from the live graph while it still matches the
+        snapshot's epoch."""
+        graph = getattr(self.source, "graph", None)
+        if graph is None:
+            raise FallbackToInterpreter(
+                f"snapshot carries no vertex columns for {keys!r} and "
+                "no source graph to build them from")
+        if getattr(snap, "_graph", None) is None:
+            # an unbound snapshot (from_arrays / closed) has NO epoch
+            # relationship to the live graph — snap.stale is vacuously
+            # False, so building columns from the live graph could mix
+            # datasets undetectably. The user must attach columns from
+            # a source they know matches.
+            raise ValueError(
+                f"snapshot is not bound to a graph: cannot safely build "
+                f"the {keys!r} property columns from the live graph — "
+                "call snapshot.attach_vertex_values(graph, keys) "
+                "yourself with a graph that matches the snapshot")
+        if snap.stale:
+            # the live graph has moved past the snapshot's epoch: a
+            # column built now would mix datasets (new property values
+            # over old topology). Mirrors the explicit-snapshot
+            # label-code guard in run() — the snapshot IS the dataset.
+            if not getattr(snap, "_auto_built", False):
+                raise ValueError(
+                    f"snapshot is stale (epoch {snap.epoch} < graph "
+                    f"mutation epoch): building the {keys!r} property "
+                    "columns from the live graph would mix datasets — "
+                    "call snapshot.refresh() first")
+            raise FallbackToInterpreter(
+                f"auto snapshot went stale before the {keys!r} columns "
+                "were attached")
+        try:
+            snap.attach_vertex_values(graph, keys)
+        except ValueError as e:           # e.g. non-SINGLE cardinality
+            raise FallbackToInterpreter(str(e)) from e
 
     def _vertex_column(self, snap, key: str):
         got = snap.vertex_values.get(key)
         if got is None:
-            graph = getattr(self.source, "graph", None)
-            if graph is None:
-                raise FallbackToInterpreter(
-                    f"snapshot carries no vertex column for {key!r} and "
-                    "no source graph to build it from")
-            try:
-                snap.attach_vertex_values(graph, [key])
-            except ValueError as e:       # e.g. non-SINGLE cardinality
-                raise FallbackToInterpreter(str(e)) from e
+            self._attach_columns(snap, [key])
             got = snap.vertex_values[key]
         return got
 
@@ -391,6 +446,13 @@ def try_compile(steps: list, source) -> Optional[CompiledTraversal]:
             if j != len(steps):
                 return None
             if by is not None and not isinstance(by, str):
+                return None
+            if by == "id":
+                # interpreter parity: by('id') buckets by element id,
+                # which is exactly the compiled by=None representation
+                by = None
+            elif by == "label":
+                # vertex labels are not carried in the snapshot
                 return None
             terminal = ("groupCount", by)
             i = len(steps)
