@@ -172,6 +172,38 @@ def bfs_teps(scale: int, edge_factor: int = 16, seed: int = 2,
     jax.block_until_ready(dist)
     first_s = time.time() - t0
 
+    # single-dispatch fused variant (device-side mode/bucket switch —
+    # kills the per-level readback floor on slow-tunnel days). "auto":
+    # only when a previous successful fused run at THIS scale left a
+    # marker (the persistent compile cache is then warm for it) — a
+    # cold fused compile costs many minutes through the tunnel, and
+    # checking for mere cache entries would be fooled by the plain
+    # hybrid's own warmup compiles.
+    fused_mode = os.environ.get("TITAN_TPU_FUSED_BFS", "auto")
+    marker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".bench_cache", f"fused_warm_s{scale}.flag")
+    run_fused = ndev == 1 and (
+        fused_mode == "1"
+        or (fused_mode == "auto" and os.path.exists(marker)))
+    fused_fn = None
+    fused_first_s = None
+    if run_fused:
+        from titan_tpu.models.bfs_hybrid_fused import \
+            frontier_bfs_hybrid_fused
+
+        def fused_fn(source):
+            return frontier_bfs_hybrid_fused(g, source,
+                                             return_device=True)
+        t0 = time.time()
+        try:
+            dist_f, _ = fused_fn(srcs[0])
+            jax.block_until_ready(dist_f)
+            fused_first_s = time.time() - t0
+            with open(marker, "w") as fh:
+                fh.write("ok\n")
+        except Exception:
+            fused_fn = None          # e.g. OOM at this scale: skip
+
     deg_dev = graph500.device_degrees(np.asarray(hg["deg_orig"]))
     per_source = []
     for source in srcs:
@@ -182,6 +214,15 @@ def bfs_teps(scale: int, edge_factor: int = 16, seed: int = 2,
             jax.block_until_ready(dist)
             times.append(time.time() - t0)
         t_bfs = min(times)
+        if fused_fn is not None:
+            tf = []
+            for _ in range(reps):
+                t0 = time.time()
+                dist_f, levels_f = fused_fn(source)
+                jax.block_until_ready(dist_f)
+                tf.append(time.time() - t0)
+            if min(tf) < t_bfs:     # report the better variant
+                t_bfs, dist, levels = min(tf), dist_f, levels_f
         m2, nreach = graph500.reachable_edge_sum(
             dist, np.asarray(hg["deg_orig"]), int(INF), deg_dev=deg_dev)
         per_source.append({"teps": (m2 // 2) / t_bfs, "t_bfs": t_bfs,
@@ -197,6 +238,9 @@ def bfs_teps(scale: int, edge_factor: int = 16, seed: int = 2,
                 "n": hg["n"], "e_sym_pre_dedup": hg["e_sym"],
                 "e_dedup": hg["e_dedup"], "num_sources": len(per_source),
                 "n_devices": ndev,
+                "fused_variant_ran": fused_fn is not None,
+                "fused_first_s": round(fused_first_s, 2)
+                if fused_first_s is not None else None,
                 "per_source_teps": [round(r["teps"], 1)
                                     for r in per_source]})
     return rep
@@ -455,17 +499,11 @@ def gods_2hop(rep: Report) -> None:
 def main() -> None:
     import jax
 
-    try:
-        # persist compiled executables across bench processes (first-run
-        # compiles go through the axon tunnel at ~10-60s per shape bucket)
-        cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".bench_cache", "xla")
-        os.makedirs(cache, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-    except Exception:
-        pass
+    # persist compiled executables across bench processes (first-run
+    # compiles go through the axon tunnel at ~10-60s per shape bucket);
+    # single source of truth for the cache path/config
+    from titan_tpu.utils.jitcache import enable_compile_cache
+    enable_compile_cache()
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)

@@ -1,0 +1,21 @@
+"""Scale-26 BFS wall-clock check on the real chip (uses the bench's own
+measurement path). Run from repo root after the graph cache exists."""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import bench  # noqa: E402
+
+
+def main(scale=26):
+    t0 = time.time()
+    r = bench.bfs_teps(scale, reps=3)
+    print(f"total stage {time.time() - t0:.1f}s")
+    for k in ("teps", "t_bfs", "levels", "m_traversed", "first_s",
+              "gen_s", "upload_s"):
+        print(k, r[k])
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 26)

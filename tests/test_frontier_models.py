@@ -255,3 +255,100 @@ def test_hybrid_max_levels_truncates():
     assert levels <= 2
     assert dist[1] == 1 and dist[2] == 2
     assert (dist[3:] >= INF).all()
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_hybrid_bfs_split_lane_opener_matches(seed, monkeypatch):
+    """Force the split-lane bottom-up opener (4-lane test + lanes-4-7
+    refetch) on small graphs and check bit-equality with the plain BFS
+    (in production it only engages above 2^21 candidates)."""
+    monkeypatch.setattr(H, "SPLIT_LANE_MIN", 2)
+    # also disable the fused endgame + head fast paths so the bu0a/bu0b
+    # opener actually runs on these tiny graphs
+    monkeypatch.setattr(H, "END_C_CAP", 0)
+    monkeypatch.setattr(H, "END_P_CAP", 0)
+    monkeypatch.setattr(H, "HEAD_F_CAP", 1)
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(100, 500))
+    snap = sym_snap(rng, n, int(rng.integers(2 * n, 8 * n)))
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
+    d_ref, _ = frontier_bfs(snap, source)
+    d_hyb, _ = H.frontier_bfs_hybrid(snap, source)
+    assert (d_ref == np.asarray(d_hyb)).all()
+
+
+def test_hybrid_bfs_split_lane_rmat(monkeypatch):
+    monkeypatch.setattr(H, "SPLIT_LANE_MIN", 2)
+    monkeypatch.setattr(H, "END_C_CAP", 0)
+    monkeypatch.setattr(H, "END_P_CAP", 0)
+    monkeypatch.setattr(H, "HEAD_F_CAP", 1)
+    src, dst = rmat_edges(11, 8, seed=9)
+    n = 1 << 11
+    snap = snap_mod.from_arrays(n, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
+    d_ref, _ = frontier_bfs(snap, source)
+    d_hyb, _ = H.frontier_bfs_hybrid(snap, source)
+    assert (d_ref == np.asarray(d_hyb)).all()
+
+
+# ---------------------------------------------------------------- fused BFS
+
+import titan_tpu.models.bfs_hybrid_fused as FU
+
+
+@pytest.mark.parametrize("seed", [4, 5])
+def test_fused_bfs_matches_reference(seed, monkeypatch):
+    """Single-dispatch BFS (device-side mode + bucket switching) is
+    bit-equal to the plain BFS; endgame disabled so the td/bu ladder
+    branches actually execute on CPU-sized graphs."""
+    monkeypatch.setattr(FU, "END_C_CAP", 1)
+    monkeypatch.setattr(FU, "END_P_CAP", 1)
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(80, 400))
+    snap = sym_snap(rng, n, int(rng.integers(2 * n, 8 * n)))
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
+    d_ref, _ = frontier_bfs(snap, source)
+    d_f, _ = FU.frontier_bfs_hybrid_fused(snap, source)
+    assert (d_ref == np.asarray(d_f)).all()
+
+
+def test_fused_bfs_rmat_and_endgame():
+    src, dst = rmat_edges(11, 8, seed=4)
+    n = 1 << 11
+    snap = snap_mod.from_arrays(n, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
+    d_ref, _ = frontier_bfs(snap, source)
+    d_f, _ = FU.frontier_bfs_hybrid_fused(snap, source)
+    assert (d_ref == np.asarray(d_f)).all()
+
+
+def test_fused_bfs_path_graph(monkeypatch):
+    monkeypatch.setattr(FU, "END_C_CAP", 1)
+    monkeypatch.setattr(FU, "END_P_CAP", 1)
+    n = 300
+    src = np.arange(n - 1, dtype=np.int32)
+    snap = snap_mod.from_arrays(n, np.concatenate([src, src + 1]),
+                                np.concatenate([src + 1, src]))
+    d_ref, _ = frontier_bfs(snap, 0)
+    d_f, lv = FU.frontier_bfs_hybrid_fused(snap, 0)
+    assert (d_ref == np.asarray(d_f)).all() and lv >= n - 1
+
+
+def test_sssp_quantile_matches_plain():
+    """Quantile-batched SSSP (priority bands) is exact: same distances
+    as the plain expand-all-improved frontier and the Bellman-Ford
+    ground truth."""
+    from titan_tpu.models.frontier import frontier_sssp
+    rng = np.random.default_rng(17)
+    n = 220
+    m = 1400
+    s = rng.integers(0, n, m)
+    d = rng.integers(0, n, m)
+    snap = snap_mod.from_arrays(n, np.concatenate([s, d]),
+                                np.concatenate([d, s]))
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
+    d_q, r_q = frontier_sssp(snap, source, quantile_mass=64)
+    d_p, r_p = frontier_sssp(snap, source, quantile_mass=0)
+    assert np.allclose(d_q, d_p, rtol=1e-6)

@@ -102,6 +102,17 @@ CLUSTER_MAX_HINTS = ConfigOption(
     "hinted-handoff queue cap per down peer; overflow converges via "
     "merged reads + the next anti-entropy pass", int, 50_000,
     Mutability.MASKABLE, positive)
+CLUSTER_COMPACTION_INTERVAL = ConfigOption(
+    CLUSTER_NS, "compaction-interval-s",
+    "period of the background anti-entropy + tombstone-GC daemon "
+    "(0 disables; cycles are skipped while a replica is down or hints "
+    "are undelivered — the Cassandra scheduled repair/compaction role)",
+    float, 0.0, Mutability.MASKABLE, lambda v: v >= 0.0)
+CLUSTER_GC_GRACE = ConfigOption(
+    CLUSTER_NS, "gc-grace-seconds",
+    "minimum tombstone age before the compaction daemon may purge it "
+    "(Cassandra gc_grace_seconds role)", float, 86400.0,
+    Mutability.MASKABLE, lambda v: v >= 0.0)
 
 SCAN_NS = ConfigNamespace(STORAGE_NS, "scan", "backend scan framework")
 SCAN_THREADS = ConfigOption(

@@ -59,6 +59,13 @@ ALPHA = 8.0
 # after this many 8-edge chunks checked per candidate, survivors go to the
 # exhaustive sweep
 BU_CHUNK_ROUNDS = 8
+# split-lane bottom-up opener: at heavy levels, test lanes 0-3 of chunk 0
+# first (halves the bitmap-gather count; measured fetch+test 0.427s ->
+# 0.268s per 4.2M candidates, experiments/lane_split_probe.py) and only
+# refetch lanes 4-7 for the ~10% of candidates that miss (measured
+# miss4 = 9.7% at the scale-23 heavy level). Below this candidate-cap
+# the extra dispatch+readback outweighs the gather saving.
+SPLIT_LANE_MIN = 1 << 21
 # head loop caps: early top-down levels fused into one dispatch while the
 # frontier stays under these
 HEAD_F_CAP = 1 << 12
@@ -338,6 +345,112 @@ def _bu_start():
     return _get("hybrid_bu_start", build)
 
 
+def _bu_start4():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("c_cap", "n_"),
+                           donate_argnums=(0,))
+        def bu0a(dist, level, dstT, colstart, degc, deg, c_cap: int,
+                 n_: int):
+            """Split-lane bottom-up opener: candidate build + a 4-LANE
+            chunk-0 bitmap test (dstT[:4] fuses into the gather — no
+            copy, see experiments/lane_split_probe.py). Candidates that
+            miss lanes 0-3 AND have deg > 4 are compacted as UNTESTED
+            (their lanes 4-7 may still hit — _bu_finish_chunk0 decides
+            them at a host-sized cap); deg <= 4 misses are decided (pad
+            lanes never hit). Level-end stats under lax.cond when no
+            untested remain (then no bu_more survivors can exist either,
+            since degc > 1 implies deg > 8)."""
+            q_pad = dstT.shape[1] - 1
+            fbits = _pack_bits(dist, level, n_)
+            unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
+            cand = jnp.nonzero(unvis, size=c_cap,
+                               fill_value=n_)[0].astype(jnp.int32)
+            c_count = unvis.sum().astype(jnp.int32)
+
+            alive = jnp.arange(c_cap) < c_count
+            v = jnp.minimum(cand, n_)
+            cols = jnp.where(alive, colstart[v], q_pad)
+            parents4 = jnp.take(dstT[:4], jnp.clip(cols, 0, q_pad),
+                                axis=1)
+            hit4 = _bit_of(fbits, parents4)
+            found = alive & hit4.any(axis=0)
+            dist = dist.at[jnp.where(found, v, n_ + 1)].set(
+                level + 1, mode="drop")
+            untested = alive & ~found & (deg[v] > 4)
+            nu = untested.sum().astype(jnp.int32)
+
+            def compact(_):
+                idx = jnp.nonzero(untested, size=c_cap,
+                                  fill_value=c_cap - 1)[0]
+                keep = jnp.arange(c_cap) < nu
+                return jnp.where(keep, cand[idx], n_).astype(jnp.int32)
+
+            def no_compact(_):
+                return jnp.full((c_cap,), n_, jnp.int32)
+
+            cand2 = jax.lax.cond(nu > 0, compact, no_compact, None)
+            st = jax.lax.cond(
+                nu == 0,
+                lambda _: _level_stats(dist, degc, level, n_),
+                lambda _: jnp.zeros((4,), jnp.int32), None)
+            return dist, fbits, cand2, jnp.stack([nu]), st
+        return bu0a
+    return _get("hybrid_bu_start4", build)
+
+
+def _bu_finish_chunk0():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("c_cap", "n_"),
+                           donate_argnums=(0,))
+        def bu0b(dist, fbits, cand, level, dstT, colstart, degc,
+                 c_cap: int, n_: int):
+            """Finish chunk 0 for the split-lane opener's untested
+            candidates: test lanes 4-7, scatter the hits, compact the
+            full-chunk-0 misses with degc > 1 for the bu_more rounds
+            (off starts at 1 — chunk 0 is now fully consumed)."""
+            q_pad = dstT.shape[1] - 1
+            c_count = (cand < n_).sum().astype(jnp.int32)
+            alive = jnp.arange(c_cap) < c_count
+            v = jnp.minimum(cand, n_)
+            cols = jnp.where(alive, colstart[v], q_pad)
+            parents47 = jnp.take(dstT[4:], jnp.clip(cols, 0, q_pad),
+                                 axis=1)
+            found = alive & _bit_of(fbits, parents47).any(axis=0)
+            dist = dist.at[jnp.where(found, v, n_ + 1)].set(
+                level + 1, mode="drop")
+            surv = alive & ~found & (degc[v] > 1)
+            nc = surv.sum().astype(jnp.int32)
+
+            def compact(_):
+                idx = jnp.nonzero(surv, size=c_cap,
+                                  fill_value=c_cap - 1)[0]
+                keep = jnp.arange(c_cap) < nc
+                cand2 = jnp.where(keep, cand[idx], n_)
+                rem8 = jnp.where(surv, degc[v] - 1, 0) \
+                    .sum(dtype=jnp.int32)
+                return cand2.astype(jnp.int32), rem8
+
+            def no_compact(_):
+                return jnp.full((c_cap,), n_, jnp.int32), jnp.int32(0)
+
+            cand2, rem8 = jax.lax.cond(nc > 0, compact, no_compact, None)
+            st = jax.lax.cond(
+                nc == 0,
+                lambda _: _level_stats(dist, degc, level, n_),
+                lambda _: jnp.zeros((4,), jnp.int32), None)
+            return dist, cand2, jnp.stack([nc, rem8]), st
+        return bu0b
+    return _get("hybrid_bu_finish0", build)
+
+
 def _bu_more():
     def build():
         import jax
@@ -516,9 +629,12 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
     g = snap if isinstance(snap, dict) else build_chunked_csr(snap)
     n = g["n"]
     dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
+    deg = g["deg"]
     head = _head_loop()
     td = _td_step()
     bu0 = _bu_start()
+    bu0a = _bu_start4()
+    bu0b = _bu_finish_chunk0()
     bu = _bu_more()
     ex = _bu_exhaust()
     endgame = _endgame()
@@ -581,10 +697,27 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
                 (int(x) for x in np.asarray(st_dev))
         else:
             c_cap = min(_next_pow2(max(n_unvis, 2)), cap_n)
-            dist, fbits, cand, prog, st_dev = bu0(
-                dist, dev_scalar(level), dstT, colstart, degc,
-                c_cap=c_cap, n_=n)
-            nc, rem8 = (int(x) for x in np.asarray(prog))
+            if c_cap >= SPLIT_LANE_MIN:
+                # split-lane opener: 4-lane test over everyone, then
+                # lanes 4-7 only for the ~10% that missed (host-sized)
+                dist, fbits, cand, prog, st_dev = bu0a(
+                    dist, dev_scalar(level), dstT, colstart, degc,
+                    deg, c_cap=c_cap, n_=n)
+                nu = int(np.asarray(prog)[0])
+                if nu > 0:
+                    u_cap = min(_next_pow2(max(nu, 2)), cap_n)
+                    cand = pad(cand)
+                    dist, cand, prog, st_dev = bu0b(
+                        dist, fbits, cand[:u_cap], dev_scalar(level),
+                        dstT, colstart, degc, c_cap=u_cap, n_=n)
+                    nc, rem8 = (int(x) for x in np.asarray(prog))
+                else:
+                    nc, rem8 = 0, 0
+            else:
+                dist, fbits, cand, prog, st_dev = bu0(
+                    dist, dev_scalar(level), dstT, colstart, degc,
+                    c_cap=c_cap, n_=n)
+                nc, rem8 = (int(x) for x in np.asarray(prog))
             rounds = 1
             off = None
             while nc > 0 and rounds < BU_CHUNK_ROUNDS:

@@ -124,11 +124,46 @@ def _wrap_plan(kind: str):
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
-                           static_argnames=("n_", "k_max", "budget"))
+                           static_argnames=("n_", "k_max", "budget",
+                                            "quantile_mass", "bins"))
         def wrapplan(val, val_exp, degc, bucket_end, n_: int, k_max: int,
-                     budget: int):
+                     budget: int, quantile_mass: int = 0,
+                     bins: int = 512):
             hasdeg = degc[:n_] > 0
             changed = (val[:n_] < val_exp[:n_]) & hasdeg
+            if quantile_mass:
+                # priority-batched threshold (approximate Dijkstra):
+                # histogram the improved vertices' values and pick the
+                # smallest threshold whose in-band chunk mass reaches
+                # ``quantile_mass`` — expansion happens in near-sorted
+                # value order, so a vertex is rarely re-expanded (the
+                # Dijkstra no-re-expansion property, batched). This is
+                # NOT delta-stepping: the band adapts to wherever the
+                # mass is, so the power-law one-bucket collapse
+                # (PERF_NOTES r4) cannot happen.
+                big_ = jnp.asarray(
+                    FINF if val.dtype == jnp.float32 else IINF,
+                    val.dtype)
+                vals = jnp.where(changed, val[:n_], big_)
+                lo = vals.min()
+                hi0 = jnp.where(changed, val[:n_],
+                                -big_ if val.dtype == jnp.float32
+                                else -IINF).max()
+                span = jnp.maximum(hi0 - lo, 1e-30)
+                b = jnp.clip(((val[:n_] - lo) / span
+                              * bins).astype(jnp.int32), 0, bins - 1)
+                hist = jnp.zeros((bins,), jnp.int32).at[
+                    jnp.where(changed, b, bins - 1)].add(
+                    jnp.where(changed, degc[:n_], 0), mode="drop")
+                cum = jnp.cumsum(hist)
+                pick = jnp.searchsorted(
+                    cum, jnp.int32(quantile_mass), side="left")
+                pick = jnp.minimum(pick, bins - 1)
+                thr = lo + span * (pick + 1).astype(val.dtype) / bins
+                # strict `val < thr` must include the minimum bin even
+                # when the band has collapsed to a point
+                thr = jnp.maximum(thr, jnp.nextafter(lo, big_))
+                bucket_end = thr
             inb = changed & (val[:n_] < bucket_end)
             nf = inb.sum().astype(jnp.int32)
             cummass = jnp.cumsum(
@@ -158,11 +193,12 @@ def _wrap_plan(kind: str):
                 [jnp.stack([nf, m8]), bounds, bmass,
                  jax.lax.bitcast_convert_type(pmin, jnp.int32)[None]
                  if val.dtype == jnp.float32 else pmin[None]])
-            # bounds returned separately ON DEVICE: push slices read
-            # their vertex range from it via pooled index scalars, so
-            # the host never ships per-slice bounds (each scalar put is
-            # a ~0.1-0.9s tunnel round trip)
-            return plan, bounds
+            # bounds (and the effective bucket threshold — quantile mode
+            # computes it on device) returned separately ON DEVICE: push
+            # slices read their vertex range / threshold from them via
+            # pooled index scalars, so the host never ships per-slice
+            # values (each scalar put is a ~0.1-0.9s tunnel round trip)
+            return plan, bounds, jnp.asarray(bucket_end, val.dtype)
         return wrapplan
     return jit_once(f"frontier_wrapplan_{kind}", build)
 
@@ -229,6 +265,91 @@ def _push_slice(kind: str):
     return jit_once(f"frontier_push_{kind}", build)
 
 
+def _list_plan(kind: str):
+    """Quantile-mode round prep: compact the in-band improved vertices
+    into a LIST and mass-balance it into segment bounds. The vertex-
+    range push slicing pays ceil(n / 2^23) windows per slice even when
+    the band is tiny and scattered (measured scale-26: ~295s despite a
+    3.9x relaxation-mass cut — dispatch-bound); the list path dispatches
+    ONE push per ~budget chunks of actual mass."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("n_", "f_cap", "k_max",
+                                            "budget"))
+        def listplan(val, val_exp, degc, thr, n_: int, f_cap: int,
+                     k_max: int, budget: int):
+            inb = (val[:n_] < val_exp[:n_]) & (degc[:n_] > 0) \
+                & (val[:n_] < thr)
+            flist = jnp.nonzero(inb, size=f_cap,
+                                fill_value=n_)[0].astype(jnp.int32)
+            valid = flist < n_
+            degl = jnp.where(valid, degc[jnp.minimum(flist, n_)], 0)
+            cmass = jnp.cumsum(degl.astype(jnp.int32))
+            targets = jnp.arange(1, k_max + 1, dtype=jnp.int32) * budget
+            lb = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.searchsorted(cmass, targets,
+                                  side="right").astype(jnp.int32)])
+            return flist, jnp.minimum(lb, jnp.int32(f_cap))
+        return listplan
+    return jit_once(f"frontier_listplan_{kind}", build)
+
+
+def _push_list(kind: str):
+    """Push one mass-balanced SEGMENT of the round's compacted in-band
+    list (quantile mode). Membership is rechecked live (an earlier
+    segment may have improved a member further — it pushes its current
+    value); a vertex appears in exactly one segment and segment mass is
+    fixed by the plan, so p_cap = pow2(segment mass) never defers."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("f_cap", "p_cap", "n_"),
+                           donate_argnums=(0, 1))
+        def pushl(val, val_exp, flist, lbounds, i, thr, dstT, colstart,
+                  degc, wparams, f_cap: int, p_cap: int, n_: int):
+            p0 = lbounds[i]
+            p1 = lbounds[i + 1]
+            L = flist.shape[0]
+            s0 = jnp.clip(p0, 0, max(L - f_cap, 0))
+            pos = s0 + jnp.arange(f_cap, dtype=jnp.int32)
+            seg = jax.lax.dynamic_slice(flist, (s0,), (f_cap,))
+            v = jnp.minimum(seg, n_)
+            member = (pos >= p0) & (pos < p1) & (seg < n_) \
+                & (val[v] < val_exp[v]) & (val[v] < thr)
+            valv = val[v]
+            counts = jnp.where(member, degc[v], 0).astype(jnp.int32)
+            # a segment's true mass can exceed the plan target by one
+            # straddling vertex; only members whose WHOLE chunk range
+            # fits p_cap are marked expanded — the rest stay improved
+            # and the next round re-plans them (same contract as the
+            # vertex-range push)
+            ends = jnp.cumsum(counts)
+            fits = member & (ends <= p_cap)
+            val_exp = val_exp.at[jnp.where(fits, v, n_ + 1)].set(
+                valv, mode="drop")
+            cols, _, owner = enumerate_chunk_pairs(
+                fits, counts, colstart[v], p_cap, dstT.shape[1] - 1,
+                with_owner=True)
+            src_val = valv[owner]
+            nbr = jnp.take(dstT, cols, axis=1)
+            if kind == "sssp":
+                lane = jnp.arange(8, dtype=jnp.int32)[:, None]
+                slot = cols[None, :] * 8 + lane
+                w = _hash_weight_expr(slot, wparams[0], wparams[1])
+                msg = src_val[None, :] + w
+            else:
+                msg = jnp.broadcast_to(src_val[None, :], nbr.shape)
+            return val.at[nbr].min(msg, mode="drop"), val_exp
+        return pushl
+    return jit_once(f"frontier_pushlist_{kind}", build)
+
+
 def _max_degc(g) -> int:
     got = g.get("_max_degc")
     if got is None:
@@ -241,16 +362,22 @@ def _max_degc(g) -> int:
 # width trades dispatch count against the src_val gather table size
 # (2^23 int32 = 32MB, the last fast-gather size — see PERF_NOTES.md)
 SLICE_WIDTH = 1 << 23
+# default per-round band mass (chunks) for quantile-batched SSSP
+QUANTILE_MASS_DEFAULT = 1 << 24
 
 
 def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
-                  max_rounds: int, delta: float | None = None):
+                  max_rounds: int, delta: float | None = None,
+                  quantile_mass: int = 0):
     """Expansion-tracked round loop: one plan readback per round, then
     budget-bounded vertex-range push dispatches. With ``delta``, rounds
     expand only the current distance bucket (one-sided) and the bucket
     advances to the minimum pending value when it drains —
-    delta-stepping. Without it, every improved vertex is eligible every
-    round."""
+    delta-stepping. With ``quantile_mass``, each round's threshold is
+    computed ON DEVICE so the expanded band carries ~that much chunk
+    mass — priority-batched expansion in near-sorted value order (see
+    _wrap_plan). Without either, every improved vertex is eligible
+    every round."""
     import jax.numpy as jnp
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
@@ -288,8 +415,9 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
     escalate = False
     while rounds < max_rounds:          # collect (bucket_end, nf, m8)
         be_dev = dev_scalar(bucket_end, dtname)
-        plan, bounds_dev = wrapplan(val, val_exp, degc, be_dev, n_=n,
-                                    k_max=SLICE_K_MAX, budget=budget)
+        plan, bounds_dev, thr_dev = wrapplan(
+            val, val_exp, degc, be_dev, n_=n, k_max=SLICE_K_MAX,
+            budget=budget, quantile_mass=quantile_mass)
         plan_h = np.asarray(plan)          # ONE sync per round
         nf, m8 = (int(x) for x in plan_h[:2])
         bounds = plan_h[2:2 + SLICE_K_MAX + 1]
@@ -301,10 +429,49 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
         if nf == 0 or m8 == 0:
             if float(pmin) >= big * (1 - 1e-6):
                 return val[:n], rounds     # no pending work anywhere
+            if quantile_mass:
+                # the device threshold always includes the minimum bin,
+                # so an empty round with pending work cannot recur —
+                # but guard against fp corner-cases by escalating to a
+                # full round
+                quantile_mass = 0
+                continue
             # bucket drained: advance to the minimum pending value's
             # bucket (strictly increases — pmin >= current bucket_end)
             bucket_end = float((np.floor(float(pmin) / delta) + 1)
                                * delta)
+            continue
+        sig_q = (nf, m8, float(pmin))
+        if quantile_mass and sig_q == prev_sig:
+            # two identical rounds = every member was fits-deferred
+            # (pathological segment packing) — permanently fall back to
+            # the vertex-range path, whose escalate handling is proven
+            quantile_mass = 0
+        prev_sig = sig_q if quantile_mass else prev_sig
+        if quantile_mass:
+            # list path: compact the (small, scattered) band once and
+            # push mass-balanced segments — one dispatch per ~budget
+            # chunks instead of ceil(n/width) windows per slice
+            listplan = _list_plan(kind)
+            pushl = _push_list(kind)
+            f_cap = min(_next_pow2(max(nf, 2)), w_max)
+            flist, lbounds = listplan(val, val_exp, degc, thr_dev,
+                                      n_=n, f_cap=f_cap,
+                                      k_max=SLICE_K_MAX, budget=budget)
+            nseg = min(-(-m8 // budget), SLICE_K_MAX)
+            for k in range(nseg):
+                # +max_dc headroom: a vertex straddling the mass target
+                # lands wholly in one segment (full segments then size
+                # to exactly p_full — the budget is pre-shaved by
+                # max_dc, see above)
+                mass_k = min(budget, m8 - k * budget) + max_dc
+                p_cap = min(_next_pow2(max(mass_k, 2)), p_full)
+                fk = min(f_cap, p_cap)
+                val, val_exp = pushl(
+                    val, val_exp, flist, lbounds, dev_scalar(k),
+                    thr_dev, dstT, colstart, degc, wp,
+                    f_cap=fk, p_cap=p_cap, n_=n)
+            rounds += 1
             continue
         # a round that changed NOTHING means every remaining member was
         # fits-deferred (its chunk range exceeded the tight p_cap) —
@@ -330,6 +497,8 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
             # device-side width split: sub index selects a width-window
             # of slice i, both from the scalar pool — no host puts
             for j in range((vhi - vlo + width - 1) // width):
+                # quantile rounds never reach here (their branch ends
+                # in `continue`; the stall fallback zeroes the mode)
                 val, val_exp = push(
                     val, val_exp, bounds_dev, dev_scalar(i),
                     dev_scalar(j), be_dev, dstT, colstart, degc, wp,
@@ -341,6 +510,7 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
 def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
                   w_range: float = 1.0, max_rounds: int = 10_000,
                   delta: float | None = None,
+                  quantile_mass: int | None = None,
                   return_device: bool = False):
     """SSSP over hashed edge weights with an expansion-tracked frontier;
     ``delta`` > 0 adds delta-stepping buckets. Returns (dist float32 [n]
@@ -361,12 +531,22 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
     n = g["n"]
     if delta is None:
         delta = 0.0
+    if quantile_mass is None:
+        # default: priority-batched expansion, band mass ~the slice
+        # budget (see _wrap_plan quantile docstring) — UNLESS the
+        # caller explicitly asked for delta-stepping buckets (the two
+        # schedulers both drive bucket_end; quantile would silently
+        # override the requested delta). Pass 0 to get the plain
+        # expand-everything-improved frontier.
+        quantile_mass = 0 if delta and delta > 0 \
+            else QUANTILE_MASS_DEFAULT
     val = jnp.full((n + 1,), FINF, jnp.float32).at[source_dense].set(0.0)
     # nothing has pushed yet: only the source reads as improved
     # (val < val_exp); unreached vertices sit at val == val_exp == FINF
     val_exp = jnp.full((n + 1,), FINF, jnp.float32)
     out, rounds = _frontier_run(g, val, val_exp, "sssp",
-                                (min_w, w_range), max_rounds, delta=delta)
+                                (min_w, w_range), max_rounds,
+                                delta=delta, quantile_mass=quantile_mass)
     if not return_device:
         out = np.asarray(out)
     return out, rounds

@@ -53,6 +53,10 @@ class Backend:
                 virtual_nodes=config.get(d.CLUSTER_VNODES),
                 read_repair=config.get(d.CLUSTER_READ_REPAIR),
                 max_hints_per_peer=config.get(d.CLUSTER_MAX_HINTS))
+            interval = config.get(d.CLUSTER_COMPACTION_INTERVAL)
+            if interval > 0 and hasattr(manager, "start_auto_compaction"):
+                manager.start_auto_compaction(
+                    interval, config.get(d.CLUSTER_GC_GRACE))
         # metrics wrapping sits directly over the raw manager so every opened
         # store is instrumented, and the expiration cache layers ABOVE it —
         # cache hits don't count as backend ops (reference: Backend.java:142-146)

@@ -433,6 +433,11 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
         self.ring = HashRing(len(self._addrs), max(1, int(replication)),
                              int(virtual_nodes), self._peer_ids)
         self._stores: dict[str, ClusterStore] = {}
+        # background anti-entropy + tombstone GC (start_auto_compaction)
+        self._compactor: Optional[threading.Thread] = None
+        self._compactor_stop: Optional[threading.Event] = None
+        self.compaction_stats = {"runs": 0, "purged": 0, "skipped": 0,
+                                 "last_error": None}
         # reach at least one node up front (features: TTL = AND over
         # reachable peers, lazily refined as others connect)
         self._cell_ttl = True
@@ -667,7 +672,48 @@ class ClusterStoreManager(KeyColumnValueStoreManager):
                         f"write got {acks}/{need} acks (down: "
                         f"{[self._peer_ids[p] for p in sorted(failed)]})")
 
+    def start_auto_compaction(self, interval_s: float,
+                              grace_seconds: float) -> None:
+        """Periodic anti-entropy + tombstone GC daemon (the role of
+        Cassandra's scheduled compaction/repair; the reference delegates
+        it to the store — SURVEY §2.7 replication row). Every
+        ``interval_s`` seconds it runs ``compact_tombstones`` over the
+        currently-open stores; a cycle is SKIPPED (counted, not fatal)
+        while any replica is down or hints are undelivered — the same
+        safety rules as the manual operation. Idempotent; stopped by
+        ``close()``."""
+        if interval_s <= 0 or self._compactor is not None:
+            return
+        self._compactor_stop = threading.Event()
+
+        def loop():
+            while not self._compactor_stop.wait(interval_s):
+                names = list(self._stores)
+                if not names:
+                    continue
+                try:
+                    purged = self.compact_tombstones(
+                        names, grace_seconds=grace_seconds)
+                    self.compaction_stats["runs"] += 1
+                    self.compaction_stats["purged"] += purged
+                except TemporaryBackendError as e:
+                    # replica down / hints queued: converge later
+                    self.compaction_stats["skipped"] += 1
+                    self.compaction_stats["last_error"] = str(e)
+                except Exception as e:        # keep the daemon alive
+                    self.compaction_stats["skipped"] += 1
+                    self.compaction_stats["last_error"] = repr(e)
+
+        self._compactor = threading.Thread(
+            target=loop, name="cluster-compaction", daemon=True)
+        self._compactor.start()
+
     def close(self) -> None:
+        if self._compactor_stop is not None:
+            self._compactor_stop.set()
+        if self._compactor is not None:
+            self._compactor.join(timeout=5.0)
+            self._compactor = None
         for mgr in self._peers:
             if mgr is not None:
                 mgr.close()

@@ -14,6 +14,27 @@ from typing import Callable
 _JITS: dict = {}
 
 
+def enable_compile_cache(path: str | None = None) -> None:
+    """Point JAX's persistent compilation cache at ``path`` (default:
+    <repo>/.bench_cache/xla — shared with bench.py). First-run compiles
+    go through the axon tunnel at ~10-60s per shape bucket; every
+    experiment/bench process should call this before building kernels."""
+    import os
+
+    import jax
+    try:
+        if path is None:
+            path = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))), ".bench_cache", "xla")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass
+
+
 def jit_once(key: str, builder: Callable):
     """Return the cached jitted function for ``key``, building it with
     ``builder()`` on first use."""
