@@ -362,7 +362,13 @@ def _max_degc(g) -> int:
 # width trades dispatch count against the src_val gather table size
 # (2^23 int32 = 32MB, the last fast-gather size — see PERF_NOTES.md)
 SLICE_WIDTH = 1 << 23
-# default per-round band mass (chunks) for quantile-batched SSSP
+# default per-round band mass (chunks) for quantile-batched SSSP when
+# explicitly requested. Default is OFF: measured scale-26 (warm, same
+# chip-day): plain 247s / 1118M chunks vs quantile 350s / 497M chunks —
+# the 2.25x relaxation-mass cut is real but per-round dispatch floors
+# (~0.3-1.2s per kernel through the axon tunnel, x ~6 dispatches x 27
+# rounds) outweigh it on tunnel-attached hardware. Revisit on directly-
+# attached chips where dispatch costs are ~10x lower.
 QUANTILE_MASS_DEFAULT = 1 << 24
 
 
@@ -532,14 +538,11 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
     if delta is None:
         delta = 0.0
     if quantile_mass is None:
-        # default: priority-batched expansion, band mass ~the slice
-        # budget (see _wrap_plan quantile docstring) — UNLESS the
-        # caller explicitly asked for delta-stepping buckets (the two
-        # schedulers both drive bucket_end; quantile would silently
-        # override the requested delta). Pass 0 to get the plain
-        # expand-everything-improved frontier.
-        quantile_mass = 0 if delta and delta > 0 \
-            else QUANTILE_MASS_DEFAULT
+        # default: the plain expand-everything-improved frontier — the
+        # measured winner on tunnel-attached chips (see
+        # QUANTILE_MASS_DEFAULT). Pass quantile_mass=QUANTILE_MASS_
+        # DEFAULT (or any band mass) for priority-batched expansion.
+        quantile_mass = 0
     val = jnp.full((n + 1,), FINF, jnp.float32).at[source_dense].set(0.0)
     # nothing has pushed yet: only the source reads as improved
     # (val < val_exp); unreached vertices sit at val == val_exp == FINF
