@@ -271,8 +271,12 @@ def _bfs_stage(rep: Report, scale: int, tag: str) -> None:
         "graph_build_seconds": round(r["gen_s"], 2),
         "upload_seconds": round(r["upload_s"], 2),
     }
-    rep.headline(f"graph500_scale{scale}_bfs_teps", round(r["teps"], 1),
-                 "TEPS", round(r["teps"] / 1e9, 4))
+    if tag == "headline":
+        # only the headline scale owns the report's metric line — the
+        # warm-scale stage runs AFTER it and must not overwrite it
+        rep.headline(f"graph500_scale{scale}_bfs_teps",
+                     round(r["teps"], 1), "TEPS",
+                     round(r["teps"] / 1e9, 4))
     rep.emit()
 
 
@@ -534,8 +538,11 @@ def main() -> None:
          (lambda: ldbc_is3_4hop(rep, n_persons=1000, avg_degree=10))),
         ("bfs26", lambda: _bfs_stage(rep, headline_scale, "headline")),
         ("ssspwcc", lambda: sssp_wcc(rep, headline_scale)),
-        ("bfs23", lambda: _bfs_stage(rep, warm_scale, "warm")),
+        # the sharded-overhead stage also times the plain hybrid at the
+        # warm scale, so it outranks the standalone warm stage when the
+        # budget is tight
         ("bfs23_sharded", lambda: bfs_sharded_overhead(rep, warm_scale)),
+        ("bfs23", lambda: _bfs_stage(rep, warm_scale, "warm")),
         ("pagerank", lambda: pagerank_stage(rep, lj_scale)),
     ]
     if warm_scale == headline_scale:      # CPU/CI path: one BFS scale

@@ -171,3 +171,19 @@ def test_scan_options_wire_through(tmp_path):
         assert metrics is not None
     finally:
         g.close()
+
+
+def test_change_backlog_config_sizes_listener_queue():
+    import titan_tpu
+
+    g = titan_tpu.open({"storage.backend": "inmemory",
+                        "computer.tpu.change-backlog": 3})
+    try:
+        token, q = g.subscribe_changes()
+        assert q.cap == 3
+        for i in range(4):        # cap + 1: the 4th push overflows
+            q.push({"epoch": i})
+        assert q.overflowed and len(q) == 0
+        g.unsubscribe_changes(token)
+    finally:
+        g.close()

@@ -425,3 +425,64 @@ def test_until_is_do_while():
         assert out == ["b"]
     finally:
         g.close()
+
+
+def test_sack_path_sums_survive_bulking():
+    """TP3 sack merge rules: each traverser carries its own sack, and
+    the bulking barrier must NOT merge traversers whose sacks differ —
+    a diamond's two paths produce two distinct weight sums."""
+    g = titan_tpu.open("inmemory")
+    try:
+        tx = g.new_transaction()
+        a = tx.add_vertex("v", name="a")
+        b = tx.add_vertex("v", name="b")
+        c = tx.add_vertex("v", name="c")
+        d = tx.add_vertex("v", name="d")
+        a.add_edge("e", b, weight=1)
+        a.add_edge("e", c, weight=2)
+        b.add_edge("e", d, weight=10)
+        c.add_edge("e", d, weight=20)
+        tx.commit()
+        import operator
+
+        from titan_tpu.traversal.dsl import anon
+        out = (g.traversal().with_sack(0)
+               .V().has("name", P.eq("a"))
+               .repeat(anon().out_e("e").sack(operator.add)
+                       .by("weight").in_v())
+               .times(2).sack().to_list())
+        # two paths: 1+10 and 2+20 — distinct sacks, no merge
+        assert sorted(out) == [11, 22]
+        # equal sacks MAY merge (both paths weight 5): counts preserved
+        g2 = titan_tpu.open("inmemory")
+        tx = g2.new_transaction()
+        a2 = tx.add_vertex("v", name="a")
+        b2 = tx.add_vertex("v", name="b")
+        c2 = tx.add_vertex("v", name="c")
+        d2 = tx.add_vertex("v", name="d")
+        a2.add_edge("e", b2, weight=5)
+        a2.add_edge("e", c2, weight=5)
+        b2.add_edge("e", d2, weight=5)
+        c2.add_edge("e", d2, weight=5)
+        tx.commit()
+        out2 = (g2.traversal().with_sack(0)
+                .V().has("name", P.eq("a"))
+                .repeat(anon().out_e("e").sack(operator.add)
+                        .by("weight").in_v())
+                .times(2).sack().to_list())
+        assert sorted(out2) == [10, 10]   # one sum PER PATH, bulk or not
+        g2.close()
+    finally:
+        g.close()
+
+
+def test_sack_initial_value_reads_back():
+    g = titan_tpu.open("inmemory")
+    try:
+        tx = g.new_transaction()
+        tx.add_vertex("v", name="x")
+        tx.commit()
+        out = g.traversal().with_sack(7).V().sack().to_list()
+        assert out == [7]
+    finally:
+        g.close()

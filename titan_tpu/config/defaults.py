@@ -102,6 +102,11 @@ CLUSTER_MAX_HINTS = ConfigOption(
     "hinted-handoff queue cap per down peer; overflow converges via "
     "merged reads + the next anti-entropy pass", int, 50_000,
     Mutability.MASKABLE, positive)
+CLUSTER_TIMEOUT = ConfigOption(
+    CLUSTER_NS, "request-timeout-s",
+    "socket timeout applied to EVERY storage-node RPC (reads, "
+    "mutations, probes) on remote and remote-cluster backends", float,
+    30.0, Mutability.MASKABLE, positive)
 CLUSTER_COMPACTION_INTERVAL = ConfigOption(
     CLUSTER_NS, "compaction-interval-s",
     "period of the background anti-entropy + tombstone-GC daemon "
@@ -293,3 +298,9 @@ TPU_EDGE_BLOCK = ConfigOption(
 TPU_DTYPE = ConfigOption(
     TPU_NS, "value-dtype", "dtype for dense vertex state (bfloat16|float32)",
     str, "float32", Mutability.MASKABLE, one_of("bfloat16", "float32"))
+from titan_tpu.core.changes import CHANGE_QUEUE_CAP as _CHANGE_CAP
+TPU_CHANGE_BACKLOG = ConfigOption(
+    TPU_NS, "change-backlog",
+    "commits a snapshot's delta listener may buffer before declaring "
+    "overflow (a rebuild is then required instead of refresh())", int,
+    _CHANGE_CAP, Mutability.MASKABLE, positive)

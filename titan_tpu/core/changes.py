@@ -34,18 +34,20 @@ class ChangeQueue(list):
     """Bounded change-payload backlog for in-process subscribers (list
     subclass so the graph's registry can hold it by WEAK reference —
     builtin lists aren't weak-referenceable). ``overflowed`` means
-    payloads were dropped: delta refresh is no longer sound."""
+    payloads were dropped: delta refresh is no longer sound. The cap is
+    configurable per graph (computer.tpu.change-backlog)."""
 
-    __slots__ = ("__weakref__", "overflowed")
+    __slots__ = ("__weakref__", "overflowed", "cap")
 
-    def __init__(self):
+    def __init__(self, cap: int = CHANGE_QUEUE_CAP):
         super().__init__()
         self.overflowed = False
+        self.cap = cap
 
     def push(self, payload: dict) -> None:
         if self.overflowed:
             return
-        if len(self) >= CHANGE_QUEUE_CAP:
+        if len(self) >= self.cap:
             self.overflowed = True
             self.clear()
             return

@@ -487,7 +487,7 @@ class StandardGraph:
         from titan_tpu.core.changes import ChangeQueue
         self._listener_seq += 1
         token = self._listener_seq
-        q = ChangeQueue()
+        q = ChangeQueue(cap=self.config.get(d.TPU_CHANGE_BACKLOG))
         self._change_listeners[token] = q
         return token, q
 

@@ -350,6 +350,20 @@ def _push_list(kind: str):
     return jit_once(f"frontier_pushlist_{kind}", build)
 
 
+def _quantize_cap(mass: int, p_full: int) -> int:
+    """Round a slice's kernel width up to the next power of FOUR
+    (capped at p_full). Mass-exact pow2 caps created a distinct compile
+    per bucket — and compiles do NOT persist across processes under the
+    remote-compile backend (~8-20s each through the tunnel), so a cold
+    22-round SSSP paid more compile than compute. Power-of-four rounding
+    halves the bucket count for at most 2x dead lanes on the SMALL
+    slices (full budget-sized slices hit p_full either way)."""
+    c = _next_pow2(max(mass, 2))
+    if (c.bit_length() - 1) % 2:
+        c <<= 1
+    return min(c, p_full)
+
+
 def _max_degc(g) -> int:
     got = g.get("_max_degc")
     if got is None:
@@ -471,7 +485,7 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
                 # to exactly p_full — the budget is pre-shaved by
                 # max_dc, see above)
                 mass_k = min(budget, m8 - k * budget) + max_dc
-                p_cap = min(_next_pow2(max(mass_k, 2)), p_full)
+                p_cap = _quantize_cap(mass_k, p_full)
                 fk = min(f_cap, p_cap)
                 val, val_exp = pushl(
                     val, val_exp, flist, lbounds, dev_scalar(k),
@@ -498,8 +512,8 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
             # No max_dc pad: a member whose chunks exceed p_cap is
             # fits-deferred, and the stall signature above escalates.
             mass_i = int(bmass[i + 1]) - int(bmass[i])
-            p_cap = p_full if escalate else min(
-                _next_pow2(max(mass_i, 2)), p_full)
+            p_cap = p_full if escalate \
+                else _quantize_cap(mass_i, p_full)
             # device-side width split: sub index selects a width-window
             # of slice i, both from the scalar pool — no host puts
             for j in range((vhi - vlo + width - 1) // width):

@@ -52,7 +52,8 @@ class Backend:
                 write_consistency=config.get(d.CLUSTER_WRITE_CONSISTENCY),
                 virtual_nodes=config.get(d.CLUSTER_VNODES),
                 read_repair=config.get(d.CLUSTER_READ_REPAIR),
-                max_hints_per_peer=config.get(d.CLUSTER_MAX_HINTS))
+                max_hints_per_peer=config.get(d.CLUSTER_MAX_HINTS),
+                timeout=config.get(d.CLUSTER_TIMEOUT))
             interval = config.get(d.CLUSTER_COMPACTION_INTERVAL)
             if interval > 0 and hasattr(manager, "start_auto_compaction"):
                 manager.start_auto_compaction(

@@ -48,18 +48,20 @@ def _sqlite(directory=None, read_only=False, **kw):
     return SqliteStoreManager(directory, read_only)
 
 
-def _remote(hostname=None, port=None, **kw):
+def _remote(hostname=None, port=None, timeout=None, **kw):
     from titan_tpu.storage.remote import RemoteStoreManager
     # storage.hostname is a host LIST (reference parity); this adapter
     # currently targets one storage node
     if isinstance(hostname, (list, tuple)):
         hostname = hostname[0] if hostname else None
-    return RemoteStoreManager(hostname or "127.0.0.1", int(port or 8283))
+    return RemoteStoreManager(hostname or "127.0.0.1", int(port or 8283),
+                              timeout=float(timeout or 30.0))
 
 
 def _remote_cluster(hostname=None, port=None, replication=None,
                     write_consistency=None, virtual_nodes=None,
-                    read_repair=None, max_hints_per_peer=None, **kw):
+                    read_repair=None, max_hints_per_peer=None,
+                    timeout=None, **kw):
     from titan_tpu.storage.cluster import (MAX_HINTS_PER_PEER,
                                            ClusterStoreManager)
     hosts = hostname if isinstance(hostname, (list, tuple)) \
@@ -68,6 +70,7 @@ def _remote_cluster(hostname=None, port=None, replication=None,
                                int(replication or 1),
                                write_consistency or "all",
                                int(virtual_nodes or 64),
+                               timeout=float(timeout or 30.0),
                                read_repair=(0.1 if read_repair is None
                                             else float(read_repair)),
                                max_hints_per_peer=int(
