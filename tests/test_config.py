@@ -187,3 +187,12 @@ def test_change_backlog_config_sizes_listener_queue():
         g.unsubscribe_changes(token)
     finally:
         g.close()
+
+
+def test_change_backlog_default_single_source():
+    """The ConfigOption default and core.changes.CHANGE_QUEUE_CAP must
+    not drift (config stays a leaf module, so it cannot import the
+    constant directly)."""
+    from titan_tpu.config import defaults as d
+    from titan_tpu.core.changes import CHANGE_QUEUE_CAP
+    assert d.TPU_CHANGE_BACKLOG.default == CHANGE_QUEUE_CAP

@@ -352,3 +352,41 @@ def test_sssp_quantile_matches_plain():
     d_q, r_q = frontier_sssp(snap, source, quantile_mass=64)
     d_p, r_p = frontier_sssp(snap, source, quantile_mass=0)
     assert np.allclose(d_q, d_p, rtol=1e-6)
+
+
+def test_fused_bfs_overflow_falls_back(monkeypatch):
+    """A bu level whose candidate set exceeds the trimmed bucket ladder
+    must set the overflow stat and transparently re-run host-driven —
+    never truncate candidates (wrong distances)."""
+    monkeypatch.setattr(FU, "END_C_CAP", 1)
+    monkeypatch.setattr(FU, "END_P_CAP", 1)
+    # shrink the whole bu ladder (FUSED_BU_MAX alone is floored by the
+    # 2^23 bucket, which covers any CPU-test graph) and rebuild the
+    # cached jit so the tiny ladder actually traces
+    orig_ladders = FU._ladders
+
+    def tiny_ladders(n, total_chunks):
+        td, bu, cap_n, cap_q = orig_ladders(n, total_chunks)
+        return td, [8], cap_n, cap_q
+
+    monkeypatch.setattr(FU, "_ladders", tiny_ladders)
+    from titan_tpu.utils import jitcache
+    monkeypatch.delitem(jitcache._JITS, "hybrid_fused", raising=False)
+    # record that the host-driven fallback actually ran
+    called = []
+    real = H.frontier_bfs_hybrid
+
+    def spy(*a, **kw):
+        called.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(H, "frontier_bfs_hybrid", spy)
+    src, dst = rmat_edges(11, 8, seed=6)
+    n = 1 << 11
+    snap = snap_mod.from_arrays(n, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
+    d_ref, _ = frontier_bfs(snap, source)
+    d_f, _ = FU.frontier_bfs_hybrid_fused(snap, source)
+    assert called, "overflow did not route through the host fallback"
+    assert (d_ref == np.asarray(d_f)).all()

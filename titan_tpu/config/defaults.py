@@ -298,9 +298,10 @@ TPU_EDGE_BLOCK = ConfigOption(
 TPU_DTYPE = ConfigOption(
     TPU_NS, "value-dtype", "dtype for dense vertex state (bfloat16|float32)",
     str, "float32", Mutability.MASKABLE, one_of("bfloat16", "float32"))
-from titan_tpu.core.changes import CHANGE_QUEUE_CAP as _CHANGE_CAP
 TPU_CHANGE_BACKLOG = ConfigOption(
     TPU_NS, "change-backlog",
     "commits a snapshot's delta listener may buffer before declaring "
     "overflow (a rebuild is then required instead of refresh())", int,
-    _CHANGE_CAP, Mutability.MASKABLE, positive)
+    10_000, Mutability.MASKABLE, positive)
+# keep config a LEAF module: core.changes asserts at import that its
+# constant matches this default (tests/test_config.py pins it too)

@@ -57,11 +57,20 @@ from titan_tpu.models.bfs_hybrid import (_bit_of, _level_stats, _pack_bits,
 from titan_tpu.utils.jitcache import jit_once as _get
 
 # stats vector layout
-SF, SM8F, SM8U, SNU, SLEVEL, SDONE = range(6)
+SF, SM8F, SM8U, SNU, SLEVEL, SDONE, SOVERFLOW = range(7)
 
 BU_CHUNK_ROUNDS = 8
 END_C_CAP = 1 << 21
 END_P_CAP = 1 << 22
+# branch-memory diet: the ladders top out here instead of cap_n/cap_q —
+# every switch branch's temporaries coexist with the ~9.3GB scale-26
+# graph, and the first cut's cap_n-wide branches OOM'd at compile. A bu
+# level whose candidate count exceeds the top bucket sets the overflow
+# stat instead of truncating, and the driver transparently re-runs via
+# the host-driven hybrid (never happens on Graph500-class inputs: the
+# heavy level's candidates are ~0.4n < 2^25 at scale 26).
+FUSED_BU_MAX = 1 << 25
+FUSED_TD_MAX = (1 << 23, 1 << 25)
 
 
 def _ladders(n: int, total_chunks: int):
@@ -77,15 +86,15 @@ def _ladders(n: int, total_chunks: int):
     # A mismatched pair is pure dead-lane cost — the first fused cut
     # paired (2^18,2^22)->(2^24,2^26) and measured +44% vs the host
     # path at scale 24 because a 1M-vertex/5M-chunk frontier fell into
-    # the 2^26-wide kernel.
+    # the 2^26-wide kernel. Frontiers past the top pair force bu mode;
+    # candidates past FUSED_BU_MAX set the overflow stat (module doc).
     td = []
     for fb, pb in ((1 << 12, 1 << 18), (1 << 20, 1 << 22),
-                   (1 << 23, 1 << 25), (1 << 24, 1 << 26)):
+                   FUSED_TD_MAX):
         td.append((min(fb, cap_n), min(pb, cap_q)))
     td = sorted(set(td))
     # bu candidate caps
-    bu = sorted({min(1 << 21, cap_n), min(1 << 23, cap_n),
-                 min(1 << 25, cap_n), cap_n})
+    bu = sorted({min(1 << 23, cap_n), min(FUSED_BU_MAX, cap_n)})
     return td, bu, cap_n, cap_q
 
 
@@ -103,6 +112,9 @@ def _bu_level_body(dist, level, dstT, colstart, degc, deg, c_cap: int,
     cand = jnp.nonzero(unvis, size=c_cap,
                        fill_value=n_)[0].astype(jnp.int32)
     c_count = unvis.sum().astype(jnp.int32)
+    # a candidate set wider than the bucket would be TRUNCATED by the
+    # nonzero — flag it so the driver discards and re-runs host-driven
+    overflow = (c_count > c_cap).astype(jnp.int32)
     alive = jnp.arange(c_cap) < c_count
     v = jnp.minimum(cand, n_)
     cols = jnp.where(alive, colstart[v], q_pad)
@@ -228,7 +240,7 @@ def _bu_level_body(dist, level, dstT, colstart, degc, deg, c_cap: int,
 
     dist = jax.lax.cond(nu == 0, lambda d: d,
                         lambda d: pick(d, ul), dist)
-    return dist
+    return dist, overflow
 
 
 def _td_level_body(dist, level, dstT, colstart, degc, f_cap: int,
@@ -319,8 +331,11 @@ def _fused_bfs():
                 endgame_ok = (n_unvis <= end_c) & (m8_unvis <= end_p)
                 # a frontier that exceeds the td ladder (by count OR
                 # mass) is forced bottom-up — bu is mode-correct for
-                # any level, and its candidate ladder tops out at cap_n,
-                # so no bucket can ever truncate
+                # any level. The bu ladder tops out at FUSED_BU_MAX
+                # (memory diet), so a wider candidate set WOULD be
+                # truncated: _bu_level_body flags SOVERFLOW and the
+                # driver re-runs host-driven instead of trusting the
+                # result. Do not remove that guard.
                 use_bu = ((m8_f > m8_unvis // 8) & (f_count > 1)) \
                     | (m8_f > td_buckets[-1][1]) \
                     | (f_count > td_buckets[-1][0])
@@ -350,7 +365,8 @@ def _fused_bfs():
                     st2 = jnp.stack([
                         jnp.int32(0), jnp.int32(0), jnp.int32(0),
                         jnp.int32(0),
-                        jnp.minimum(lvl + 1, max_lv), jnp.int32(1)])
+                        jnp.minimum(lvl + 1, max_lv), jnp.int32(1),
+                        st[SOVERFLOW]])
                     return d2, st2
 
                 def td_branch(k):
@@ -362,20 +378,26 @@ def _fused_bfs():
                         st2 = jnp.stack([
                             s4[0], s4[1], s4[2], s4[3],
                             st[SLEVEL] + 1,
-                            (s4[0] == 0).astype(jnp.int32)])
+                            (s4[0] == 0).astype(jnp.int32),
+                            st[SOVERFLOW]])
                         return d2, st2
                     return go
 
                 def bu_branch(k):
                     def go(dist, st):
-                        d2 = _bu_level_body(
+                        d2, ovf = _bu_level_body(
                             dist, st[SLEVEL], dstT, colstart, degc,
                             deg, bu_buckets[k], n_)
                         s4 = _level_stats(d2, degc, st[SLEVEL], n_)
+                        ovf = jnp.maximum(st[SOVERFLOW], ovf)
                         st2 = jnp.stack([
                             s4[0], s4[1], s4[2], s4[3],
                             st[SLEVEL] + 1,
-                            (s4[0] == 0).astype(jnp.int32)])
+                            # overflow also ends the loop — the result
+                            # will be discarded by the driver anyway
+                            jnp.maximum((s4[0] == 0).astype(jnp.int32),
+                                        ovf),
+                            ovf])
                         return d2, st2
                     return go
 
@@ -418,11 +440,19 @@ def frontier_bfs_hybrid_fused(snap, source_dense: int,
         jnp.int32(1), m8_f0.astype(jnp.int32),
         jnp.where(dist[:n] >= INF, degc[:n], 0).sum(dtype=jnp.int32),
         ((dist[:n] >= INF) & (degc[:n] > 0)).sum().astype(jnp.int32),
-        jnp.int32(0), jnp.int32(0)])
+        jnp.int32(0), jnp.int32(0), jnp.int32(0)])
     dist, st = run(dist, st0, dev_scalar(max_levels), dstT, colstart,
                    degc, deg, n_=n, total_chunks=total_chunks,
                    end_c=end_c, end_p=end_p)
     st_h = np.asarray(st)
+    if int(st_h[SOVERFLOW]):
+        # a bu level's candidate set exceeded the trimmed ladder (never
+        # on Graph500-class inputs — see FUSED_BU_MAX): the fused result
+        # is invalid; re-run through the host-driven hybrid
+        from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+        return frontier_bfs_hybrid(g, source_dense,
+                                   max_levels=max_levels,
+                                   return_device=return_device)
     levels = int(st_h[SLEVEL])
     out = dist[:n]
     if not return_device:
