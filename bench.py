@@ -4,19 +4,23 @@
 The harness parses the LAST stdout line, so a timeout costs only the
 stages not yet reached — never the ones already measured (round-2
 post-mortem: a single final print + a 27-minute compile stall recorded
-nothing). Stages run cheapest-first and a wall-clock budget
-(``BENCH_BUDGET_S``, default 2400 s) skips stages that no longer fit,
-noting them in ``detail.skipped``.
+nothing). A wall-clock budget (``BENCH_BUDGET_S``, default 1100 s = the
+driver's OBSERVED external window; r4's internal 2400 s budget was
+killed at ~1200 s) skips stages that no longer fit, noting them in
+``detail.skipped``.
 
-Stage order (cheap → expensive; ssspwcc right after bfs26 so the ~10GB
-scale-26 device graph uploads once):
+Stage order (the two BASELINE HARD targets first, then measure rows,
+then droppable evidence stages):
   1. gods_2hop       — GraphOfTheGods 2-hop Gremlin count, inmemory OLTP
   2. ldbc_is3_4hop   — LDBC-SNB-style 4-hop friends expansion p50, sqlite
-  3. bfs scale-23    — Graph500 BFS TEPS, single-/multi-chip
-  4. bfs scale-26    — the headline (BASELINE.md row 1: >=1B on v5e-8,
+  3. bfs scale-26    — the headline (BASELINE.md row 1: >=1B on v5e-8,
                        125M/chip share)
+  4. pagerank s22    — LiveJournal-class s/iteration (>=50x-vs-MR row)
   5. sssp/wcc        — Graph500 scale-26 SSSP + WCC seconds
-  6. pagerank s22    — LiveJournal-class s/iteration (>=50x-vs-MR row)
+  6. store_ingest    — bulk-load s22 through the edgestore, scan back to
+                       a snapshot, BFS must match the generated graph
+  7. bfs_heavy       — Twitter-2010-parity (1.5B-edge) single-chip BFS
+  8. bfs23_sharded / bfs23 — warm-scale + sharded-overhead evidence
 
 TEPS follows the official Graph500 definition: input edge tuples (incl.
 duplicates/self-loops) with both endpoints in the traversed component /
@@ -34,7 +38,10 @@ import time
 
 import numpy as np
 
-BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+# r4 set 2400s and was killed externally at ~1200s (rc=124, losing the
+# pagerank evidence stage) — stages must be planned against the real
+# limit so the skip logic, not the kill, decides what is dropped
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1100"))
 _T_START = time.time()
 
 # conservative per-stage wall-clock estimates (seconds, accelerator path,
@@ -44,14 +51,15 @@ _T_START = time.time()
 # whether a stage still fits in the budget.
 _EST = {
     "gods_2hop": 20,
-    "ldbc": 120,
-    "bfs23": 250,        # 1.2GB upload + runs
-    "bfs23_sharded": 600,  # shard upload + per-cap-bucket kernel
-                           # compiles (~540s cold, cached after) +
-                           # 2 sharded runs (~5s each) + plain
-    "bfs26": 900,        # 9GB upload (430-830s slow-day) + 3 reps x ~14s
-    "ssspwcc": 300,      # delta-stepping SSSP + BFS-seeded WCC (r4)
-    "pagerank": 250,     # 0.6GB upload + 12 iterations
+    "ldbc": 90,
+    "bfs23": 200,        # 1.2GB upload + runs
+    "bfs23_sharded": 400,  # shard upload + per-cap-bucket kernel
+                           # compiles + 2 sharded runs (~5s each) + plain
+    "bfs26": 600,        # 9GB upload + compiles + 3 reps x ~12s
+    "ssspwcc": 300,      # frontier SSSP + BFS-seeded WCC
+    "pagerank": 120,     # 0.6GB upload + compile + 12 iterations
+    "store_ingest": 300,  # bulk ingest s22 + native scan + CSR + BFS
+    "bfs_heavy": 450,    # ~10GB upload + 2 reps (graph pre-built on disk)
 }
 
 
@@ -192,6 +200,7 @@ def bfs_teps(scale: int, edge_factor: int = 16, seed: int = 2,
         or (fused_mode == "auto" and os.path.exists(marker)))
     fused_fn = None
     fused_first_s = None
+    fused_err = None
     if run_fused:
         from titan_tpu.models.bfs_hybrid_fused import \
             frontier_bfs_hybrid_fused
@@ -204,10 +213,19 @@ def bfs_teps(scale: int, edge_factor: int = 16, seed: int = 2,
             dist_f, _ = fused_fn(srcs[0])
             jax.block_until_ready(dist_f)
             fused_first_s = time.time() - t0
-            with open(marker, "w") as fh:
-                fh.write("ok\n")
-        except Exception:
-            fused_fn = None          # e.g. OOM at this scale: skip
+        except Exception as e:       # e.g. OOM at this scale: skip
+            fused_fn = None
+            fused_err = f"{type(e).__name__}: {e}"
+        if fused_fn is not None:
+            # marker write OUTSIDE the run try-block (a marker failure
+            # must not discard a good run) but fenced on its own: a
+            # read-only FS must not abort the whole BFS stage either
+            try:
+                os.makedirs(os.path.dirname(marker), exist_ok=True)
+                with open(marker, "w") as fh:
+                    fh.write("ok\n")
+            except OSError:
+                pass                 # marker is an optimization only
 
     deg_dev = graph500.device_degrees(np.asarray(hg["deg_orig"]))
     per_source = []
@@ -244,6 +262,7 @@ def bfs_teps(scale: int, edge_factor: int = 16, seed: int = 2,
                 "e_dedup": hg["e_dedup"], "num_sources": len(per_source),
                 "n_devices": ndev,
                 "fused_variant_ran": fused_fn is not None,
+                "fused_error": fused_err,
                 "fused_first_s": round(fused_first_s, 2)
                 if fused_first_s is not None else None,
                 "per_source_teps": [round(r["teps"], 1)
@@ -397,6 +416,113 @@ def pagerank_stage(rep: Report, lj_scale: int) -> None:
     rep.emit()
 
 
+def bfs_heavy_stage(rep: Report) -> None:
+    """BASELINE row 5: Twitter-2010-class (1.5B-edge) single-chip BFS.
+    The dataset itself is unreachable in-image (zero egress), so the
+    stage substitutes an R-MAT at directed-edge-count parity: scale 25 /
+    edge-factor 44 = 1.476B generated edges vs Twitter-2010's 1.468B
+    (R-MAT s25 has 33.5M vertices vs Twitter's 41.6M). The one-time
+    graph build (~15 min C++) must already be on disk
+    (scripts/build_heavy_graph.py); the stage skips rather than blowing
+    the budget on it."""
+    from titan_tpu.olap.tpu import graph500
+
+    tag = "g500_s25_ef44_seed2"
+    if not os.path.exists(os.path.join(graph500.DEFAULT_CACHE,
+                                       tag + ".json")):
+        rep.skip("bfs_heavy", "graph cache absent (one-time ~15min "
+                 "build: python scripts/build_heavy_graph.py)")
+        return
+    r = bfs_teps(25, edge_factor=44, reps=2)
+    rep.detail["bfs_heavy_single_chip"] = {
+        "substitution": "RMAT s25 ef44 at Twitter-2010 directed-edge "
+                        "parity (1.476B vs 1.468B input edges)",
+        "teps": round(r["teps"], 1),
+        "n_vertices": r["n"],
+        "m_input_directed_edges": r["n"] * 44,
+        "m_dedup_edges": r["e_dedup"],
+        "bfs_levels": r["levels"],
+        "reachable_vertices": r["reach"],
+        "m_traversed": r["m_traversed"],
+        "bfs_seconds": round(r["t_bfs"], 4),
+        "first_run_seconds": round(r["first_s"], 2),
+        "upload_seconds": round(r["upload_s"], 2),
+    }
+    rep.emit()
+
+
+def store_ingest_stage(rep: Report, scale: int) -> None:
+    """VERDICT r4 #4 / the north-star contract: OLAP over a CSR snapshot
+    OF THE EDGE STORE at benchmark scale. Generates an R-MAT edge list,
+    bulk-loads it through the storage plane (KCVS mutations via the
+    batch-loading path, reference: GraphDatabaseConfiguration
+    STORAGE_BATCH), scans the edgestore back into a snapshot
+    (native scan), builds the chunked CSR, and runs the SAME BFS —
+    checking the result against the generated-graph BFS."""
+    import jax
+
+    from titan_tpu.models.bfs import INF
+    from titan_tpu.models.bfs_hybrid import (build_chunked_csr,
+                                             frontier_bfs_hybrid)
+    from titan_tpu.olap import bulk
+
+    t0 = time.time()
+    res = bulk.ingest_rmat_store(scale, edge_factor=16, seed=2)
+    g, snap = res["graph"], res["snapshot"]
+    try:
+        t1 = time.time()
+        csr = build_chunked_csr(snap)
+        jax.block_until_ready(csr["dstT"])
+        csr_s = time.time() - t1
+
+        # BFS on the store-derived snapshot, same source rule as the
+        # generated-graph stage. Source picked from the GENERATED graph's
+        # degrees (the store path keeps self-loops the generated CSR
+        # drops, so its nonzero-degree set can differ — the pick must
+        # match the reference stage's exactly); dense index spaces are
+        # identical because bulk ids were assigned in dense order.
+        hg, gref, _, _ = _load_device_graph(scale)   # shared/resident
+        # the dist check only holds if the reference cache and the
+        # ingest used the SAME R-MAT generator (native vs numpy edge
+        # sets differ for one seed; a native-built cache read on a
+        # native-less host would falsely indict the bulk-load path)
+        from titan_tpu import native as _native
+        gen_here = "native" if _native.available else "numpy"
+        gen_ref = hg.get("generator", gen_here)
+        deg = np.asarray(hg["deg"])
+        rng = np.random.default_rng(12345)
+        source = int(rng.choice(np.flatnonzero(deg > 0), size=1,
+                                replace=False)[0])
+        t2 = time.time()
+        dist, levels = frontier_bfs_hybrid(csr, source,
+                                           return_device=True)
+        jax.block_until_ready(dist)
+        bfs_s = time.time() - t2
+
+        # equivalence vs the generated-graph CSR: reachable count and
+        # level histogram must match exactly (duplicate edges in the
+        # store path don't change BFS distances)
+        dist_ref, levels_ref = frontier_bfs_hybrid(gref, source,
+                                                   return_device=True)
+        match = (bulk.dist_match(dist, dist_ref, int(INF))
+                 if gen_ref == gen_here else
+                 f"not comparable: reference cache built by "
+                 f"{gen_ref} generator, ingest used {gen_here}")
+        rep.detail[f"store_ingest_s{scale}"] = {
+            "n_vertices": res["n"], "m_edges_ingested": res["m"],
+            "ingest_seconds": round(res["ingest_s"], 1),
+            "scan_snapshot_seconds": round(res["scan_s"], 1),
+            "csr_build_upload_seconds": round(csr_s, 1),
+            "bfs_seconds": round(bfs_s, 3),
+            "bfs_levels": levels, "bfs_levels_ref": levels_ref,
+            "dist_matches_generated": match,
+            "total_seconds": round(time.time() - t0, 1),
+        }
+        rep.emit()
+    finally:
+        g.close()
+
+
 def ldbc_is3_4hop(rep: Report, tmp_dir: str | None = None,
                   n_persons: int = 10_000, avg_degree: int = 36) -> None:
     """BASELINE row 4: LDBC-SNB-style interactive short-read latency on
@@ -525,26 +651,31 @@ def main() -> None:
     rep.detail["platform"] = platform
     rep.detail["n_devices"] = jax.device_count()
 
-    # the HEADLINE scale runs right after the two cheap OLTP stages so
-    # a budget squeeze can never skip it (compiles do NOT persist
-    # across processes under the axon remote-compile backend, so stage
-    # first-run costs are real every time); ssspwcc follows immediately
-    # to share the one ~10GB scale-26 device upload; the warm-scale
-    # BFS + sharded-overhead evidence stages run later and are the
-    # first to be dropped under pressure; pagerank evicts the graph
+    # stage order = the two BASELINE HARD targets first (headline BFS,
+    # then pagerank >=50x-MR — r4 lost its pagerank number to the driver
+    # kill by running it last), then the "measure" rows (sssp/wcc share
+    # the resident scale-26 upload; store-ingest + heavy are new r5
+    # evidence stages), then the warm-scale/sharded evidence stages that
+    # are first to drop under pressure. The s22 pagerank graph (0.56GB)
+    # fits HBM alongside the s26 graph, so pagerank no longer evicts.
     stages = [
         ("gods_2hop", lambda: gods_2hop(rep)),
         ("ldbc", (lambda: ldbc_is3_4hop(rep)) if on_accel else
          (lambda: ldbc_is3_4hop(rep, n_persons=1000, avg_degree=10))),
         ("bfs26", lambda: _bfs_stage(rep, headline_scale, "headline")),
+        ("pagerank", lambda: pagerank_stage(rep, lj_scale)),
         ("ssspwcc", lambda: sssp_wcc(rep, headline_scale)),
+        ("store_ingest", lambda: store_ingest_stage(
+            rep, 22 if on_accel else min(headline_scale, 14))),
+        ("bfs_heavy", lambda: bfs_heavy_stage(rep)),
         # the sharded-overhead stage also times the plain hybrid at the
         # warm scale, so it outranks the standalone warm stage when the
         # budget is tight
         ("bfs23_sharded", lambda: bfs_sharded_overhead(rep, warm_scale)),
         ("bfs23", lambda: _bfs_stage(rep, warm_scale, "warm")),
-        ("pagerank", lambda: pagerank_stage(rep, lj_scale)),
     ]
+    if not on_accel:
+        stages = [s for s in stages if s[0] != "bfs_heavy"]
     if warm_scale == headline_scale:      # CPU/CI path: one BFS scale
         stages = [s for s in stages
                   if s[0] not in ("bfs23", "bfs23_sharded")]

@@ -111,6 +111,23 @@ def test_frontier_wcc_matches_union_find(seed):
     assert (np.asarray(got) == expect).all()
 
 
+@pytest.mark.parametrize("lanes", [2, 4])
+@pytest.mark.parametrize("seed", [4, 9])
+def test_hybrid_split_lane_opener_matches(lanes, seed, monkeypatch):
+    """Force the split-lane bottom-up opener (bu0a/bu0b, normally gated
+    behind SPLIT_LANE_MIN=2^21 candidates) at toy scale, for both lane
+    widths, against the plain-python reference."""
+    monkeypatch.setattr(H, "SPLIT_LANE_MIN", 1)
+    monkeypatch.setattr(H, "SPLIT_LANES", lanes)
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(100, 400))
+    snap = sym_snap(rng, n, int(rng.integers(2 * n, 6 * n)))
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
+    d_ref, _ = frontier_bfs(snap, source)
+    d_hyb, _ = H.frontier_bfs_hybrid(snap, source)
+    assert (d_ref == np.asarray(d_hyb)).all()
+
+
 @pytest.mark.parametrize("kind", ["sssp", "wcc"])
 def test_budget_sliced_rounds_match_single_slice(kind, monkeypatch):
     """Force tiny slice budgets (the scale-26 memory-bound regime: many

@@ -303,5 +303,6 @@ TPU_CHANGE_BACKLOG = ConfigOption(
     "commits a snapshot's delta listener may buffer before declaring "
     "overflow (a rebuild is then required instead of refresh())", int,
     10_000, Mutability.MASKABLE, positive)
-# keep config a LEAF module: core.changes asserts at import that its
-# constant matches this default (tests/test_config.py pins it too)
+# keep config a LEAF module: core.changes keeps its own copy of this
+# default; the pairing is pinned by
+# tests/test_config.py::test_change_backlog_default_single_source

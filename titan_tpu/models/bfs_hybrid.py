@@ -59,12 +59,19 @@ ALPHA = 8.0
 # after this many 8-edge chunks checked per candidate, survivors go to the
 # exhaustive sweep
 BU_CHUNK_ROUNDS = 8
-# split-lane bottom-up opener: at heavy levels, test lanes 0-3 of chunk 0
-# first (halves the bitmap-gather count; measured fetch+test 0.427s ->
-# 0.268s per 4.2M candidates, experiments/lane_split_probe.py) and only
-# refetch lanes 4-7 for the ~10% of candidates that miss (measured
-# miss4 = 9.7% at the scale-23 heavy level). Below this candidate-cap
-# the extra dispatch+readback outweighs the gather saving.
+# split-lane bottom-up opener: at heavy levels, test the first
+# SPLIT_LANES lanes of chunk 0 for everyone (cuts the bitmap-gather and
+# fetch width; measured fetch+test 0.427s -> 0.268s per 4.2M candidates
+# at 4 lanes, experiments/lane_split_probe.py) and refetch the remaining
+# lanes only for the minority that miss. Misses that can still hit a
+# later lane are RARE (scale-26 heavy level, 27M candidates: untested
+# after 2 lanes ~0.2M, after 4 lanes ~2k — adjacency lists are
+# id-sorted and the heavy-level frontier covers the low-id hubs), so
+# fewer leading lanes win: measured scale-26 BFS 7.72s (lanes=2) vs
+# 8.51s (lanes=4) vs 11.5s (r4 4-lane two-gather opener). Below
+# SPLIT_LANE_MIN candidates the extra dispatch+readback outweighs the
+# gather saving.
+SPLIT_LANES = 2
 SPLIT_LANE_MIN = 1 << 21
 # head loop caps: early top-down levels fused into one dispatch while the
 # frontier stays under these
@@ -272,7 +279,12 @@ def _td_step():
                f_cap: int, p_cap: int, n_: int):
             # frontier count arrives as the previous step's DEVICE stats
             # vector — shipping it back as a scalar would cost a tunnel
-            # round trip per level (~0.1s fast day, ~0.9s slow day)
+            # round trip per level (~0.1s fast day, ~0.9s slow day).
+            # The NEXT frontier list is NOT built here: the n-wide
+            # nonzero cost ~0.9s at scale 26 and the next level is
+            # usually bottom-up (which never reads it) — the driver
+            # dispatches _frontier_of lazily only when the next level
+            # stays top-down, same total compute in that case.
             f_count = stats[0]
             valid = jnp.arange(f_cap) < f_count
             v = jnp.minimum(frontier, n_)
@@ -280,10 +292,7 @@ def _td_step():
                 valid, degc[v], colstart[v], p_cap, dstT.shape[1] - 1)
             nbr = jnp.take(dstT, cols, axis=1)   # [8, p_cap], pad = n+1
             dist = dist.at[nbr].min(level + 1, mode="drop")
-            changed = dist[:n_] == level + 1
-            next_frontier = jnp.nonzero(
-                changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
-            return dist, next_frontier, _level_stats(dist, degc, level, n_)
+            return dist, _level_stats(dist, degc, level, n_)
         return td
     return _get("hybrid_td", build)
 
@@ -345,25 +354,55 @@ def _bu_start():
     return _get("hybrid_bu_start", build)
 
 
-def _bu_start4():
+def flagged_colstart(g, lanes: int):
+    """Per-graph cache: ``colstart | (deg <= lanes) << 31`` — the opener
+    needs both ``colstart[v]`` and the "could later lanes still hit?"
+    predicate, and two separate 33M-candidate gathers into 268MB tables
+    measured ~1.9s at scale 26; packing the predicate into colstart's
+    free sign bit halves that (colstart < 2^31 by the chunked-CSR int32
+    contract). Built once per graph per lane width (one n-scale
+    elementwise pass) and cached in the graph dict."""
+    import jax.numpy as jnp
+
+    key = f"_csflag{lanes}"
+    got = g.get(key)
+    if got is None:
+        def build():
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("lanes",))
+            def pack(colstart, deg, lanes: int):
+                flag = (deg <= lanes).astype(jnp.int32) << 31
+                return colstart | flag
+            return pack
+        got = _get("hybrid_csflag", build)(g["colstart"], g["deg"],
+                                           lanes=lanes)
+        g[key] = got
+    return got
+
+
+def _bu_startL():
     def build():
         import jax
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
-                           static_argnames=("c_cap", "n_"),
+                           static_argnames=("c_cap", "n_", "lanes"),
                            donate_argnums=(0,))
-        def bu0a(dist, level, dstT, colstart, degc, deg, c_cap: int,
-                 n_: int):
-            """Split-lane bottom-up opener: candidate build + a 4-LANE
-            chunk-0 bitmap test (dstT[:4] fuses into the gather — no
-            copy, see experiments/lane_split_probe.py). Candidates that
-            miss lanes 0-3 AND have deg > 4 are compacted as UNTESTED
-            (their lanes 4-7 may still hit — _bu_finish_chunk0 decides
-            them at a host-sized cap); deg <= 4 misses are decided (pad
-            lanes never hit). Level-end stats under lax.cond when no
-            untested remain (then no bu_more survivors can exist either,
-            since degc > 1 implies deg > 8)."""
+        def bu0a(dist, level, dstT, csflag, degc, c_cap: int, n_: int,
+                 lanes: int):
+            """Split-lane bottom-up opener: candidate build + a
+            ``lanes``-wide chunk-0 bitmap test (the leading-lane slice
+            ``dstT[:lanes]`` fuses into the gather — no copy, see
+            experiments/lane_split_probe.py). ``csflag`` is
+            flagged_colstart(g, lanes): one gather yields the column AND
+            the deg <= lanes predicate. Candidates that miss the tested
+            lanes AND have deg > lanes are compacted as UNTESTED (their
+            remaining lanes may still hit — _bu_finish_chunk0 decides
+            them at a host-sized cap); deg <= lanes misses are decided
+            (pad lanes never hit). Level-end stats under lax.cond when
+            no untested remain (then no bu_more survivors can exist
+            either, since degc > 1 implies deg > 8)."""
             q_pad = dstT.shape[1] - 1
             fbits = _pack_bits(dist, level, n_)
             unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
@@ -373,14 +412,16 @@ def _bu_start4():
 
             alive = jnp.arange(c_cap) < c_count
             v = jnp.minimum(cand, n_)
-            cols = jnp.where(alive, colstart[v], q_pad)
-            parents4 = jnp.take(dstT[:4], jnp.clip(cols, 0, q_pad),
+            csf = csflag[v]
+            small = csf < 0                      # deg <= lanes
+            cols = jnp.where(alive, csf & 0x7FFFFFFF, q_pad)
+            parentsL = jnp.take(dstT[:lanes], jnp.clip(cols, 0, q_pad),
                                 axis=1)
-            hit4 = _bit_of(fbits, parents4)
-            found = alive & hit4.any(axis=0)
+            hitL = _bit_of(fbits, parentsL)
+            found = alive & hitL.any(axis=0)
             dist = dist.at[jnp.where(found, v, n_ + 1)].set(
                 level + 1, mode="drop")
-            untested = alive & ~found & (deg[v] > 4)
+            untested = alive & ~found & ~small
             nu = untested.sum().astype(jnp.int32)
 
             def compact(_):
@@ -399,7 +440,7 @@ def _bu_start4():
                 lambda _: jnp.zeros((4,), jnp.int32), None)
             return dist, fbits, cand2, jnp.stack([nu]), st
         return bu0a
-    return _get("hybrid_bu_start4", build)
+    return _get("hybrid_bu_startL", build)
 
 
 def _bu_finish_chunk0():
@@ -413,17 +454,23 @@ def _bu_finish_chunk0():
         def bu0b(dist, fbits, cand, level, dstT, colstart, degc,
                  c_cap: int, n_: int):
             """Finish chunk 0 for the split-lane opener's untested
-            candidates: test lanes 4-7, scatter the hits, compact the
-            full-chunk-0 misses with degc > 1 for the bu_more rounds
-            (off starts at 1 — chunk 0 is now fully consumed)."""
+            candidates: fetch the FULL chunk (all 8 lanes — an
+            offset row slice like ``dstT[lo:]`` does NOT fuse into the
+            gather: XLA materializes it as a row-count/8 copy of the
+            whole 9GB edge array, measured as an 8.4G HLO-temp OOM at
+            scale 26; only leading slices ``dstT[:k]`` fuse. The
+            already-tested lanes re-test as guaranteed misses at a few
+            percent extra lane work on a small cap), scatter the hits,
+            compact the full-chunk-0 misses with degc > 1 for the
+            bu_more rounds (off starts at 1 — chunk 0 is consumed)."""
             q_pad = dstT.shape[1] - 1
             c_count = (cand < n_).sum().astype(jnp.int32)
             alive = jnp.arange(c_cap) < c_count
             v = jnp.minimum(cand, n_)
             cols = jnp.where(alive, colstart[v], q_pad)
-            parents47 = jnp.take(dstT[4:], jnp.clip(cols, 0, q_pad),
-                                 axis=1)
-            found = alive & _bit_of(fbits, parents47).any(axis=0)
+            parents_hi = jnp.take(dstT, jnp.clip(cols, 0, q_pad),
+                                  axis=1)
+            found = alive & _bit_of(fbits, parents_hi).any(axis=0)
             dist = dist.at[jnp.where(found, v, n_ + 1)].set(
                 level + 1, mode="drop")
             surv = alive & ~found & (degc[v] > 1)
@@ -629,11 +676,10 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
     g = snap if isinstance(snap, dict) else build_chunked_csr(snap)
     n = g["n"]
     dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
-    deg = g["deg"]
     head = _head_loop()
     td = _td_step()
     bu0 = _bu_start()
-    bu0a = _bu_start4()
+    bu0a = _bu_startL()
     bu0b = _bu_finish_chunk0()
     bu = _bu_more()
     ex = _bu_exhaust()
@@ -688,21 +734,26 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
             f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
             p_cap = min(_next_pow2(max(m8_f, 2)),
                         _next_pow2(max(total_chunks + n, 2)))
-            dist, frontier, st_dev = td(
+            dist, st_dev = td(
                 dist, frontier[:f_cap], st_dev,
                 dev_scalar(level), dstT, colstart, degc,
                 f_cap=f_cap, p_cap=p_cap, n_=n)
-            frontier = pad(frontier)
+            # the td kernel no longer builds the next frontier list —
+            # the lazy frontier_of path at the top of this branch
+            # materializes it only if the next level stays top-down
+            frontier = None
             f_count, m8_f, m8_unvis, n_unvis = \
                 (int(x) for x in np.asarray(st_dev))
         else:
             c_cap = min(_next_pow2(max(n_unvis, 2)), cap_n)
             if c_cap >= SPLIT_LANE_MIN:
-                # split-lane opener: 4-lane test over everyone, then
-                # lanes 4-7 only for the ~10% that missed (host-sized)
+                # split-lane opener: SPLIT_LANES-wide test over
+                # everyone, then the remaining lanes only for the
+                # minority that missed (host-sized)
                 dist, fbits, cand, prog, st_dev = bu0a(
-                    dist, dev_scalar(level), dstT, colstart, degc,
-                    deg, c_cap=c_cap, n_=n)
+                    dist, dev_scalar(level), dstT,
+                    flagged_colstart(g, SPLIT_LANES), degc,
+                    c_cap=c_cap, n_=n, lanes=SPLIT_LANES)
                 nu = int(np.asarray(prog)[0])
                 if nu > 0:
                     u_cap = min(_next_pow2(max(nu, 2)), cap_n)

@@ -427,6 +427,12 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
         p_full = _next_pow2(max(budget + max_dc, 2))
 
     wp = jnp.asarray(np.asarray(wparams, np.float32))
+    # the quantile threshold math in _wrap_plan is float32-only (span
+    # floor 1e-30, jnp.nextafter on lo); int-valued kinds (e.g. WCC
+    # labels) would trace-error or mis-threshold — fall back to the
+    # plain improved-set frontier for them
+    if quantile_mass and not is_f32:
+        quantile_mass = 0
     bucket_end = big if not delta or delta <= 0 else delta
     trace = g.get("_trace_rounds")      # optional perf instrumentation:
     rounds = 0                          # set g["_trace_rounds"] = [] to
