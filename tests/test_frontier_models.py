@@ -407,3 +407,18 @@ def test_fused_bfs_overflow_falls_back(monkeypatch):
     d_f, _ = FU.frontier_bfs_hybrid_fused(snap, source)
     assert called, "overflow did not route through the host fallback"
     assert (d_ref == np.asarray(d_f)).all()
+
+
+def test_sssp_quantile_list_truncation_is_sound(monkeypatch):
+    """A fixed in-band list cap smaller than the band must only defer
+    vertices (they stay improved and get re-planned), never drop or
+    corrupt distances — the soundness contract of _quant_plan's
+    truncating nonzero."""
+    monkeypatch.setattr(F, "QUANT_LIST_CAP", 8)
+    rng = np.random.default_rng(21)
+    n = 150
+    snap = sym_snap(rng, n, 600)
+    source = int(np.flatnonzero(snap.out_degree > 0)[0])
+    ref, _ = F.frontier_sssp(snap, source, quantile_mass=0)
+    got, rounds = F.frontier_sssp(snap, source, quantile_mass=64)
+    assert np.asarray(got) == pytest.approx(np.asarray(ref), rel=1e-6)

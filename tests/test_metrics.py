@@ -145,3 +145,131 @@ def test_mutate_many_single_batch(metered_graph, metrics):
     tx.commit()
     after = metrics.counter_value(f"t.{MERGED_STORE}.mutateMany.calls")
     assert after - before == 1
+
+
+# ---------------------------------------------------------------------------
+# periodic background reporters (reference: the per-namespace scheduled
+# reporter config, GraphDatabaseConfiguration.java:1010-1226)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduled_console_reporter(tmp_path):
+    import io as _io
+    import time as _time
+
+    from titan_tpu.utils.metrics import (MetricManager, ScheduledReporter,
+                                         _console_emit)
+
+    m = MetricManager()
+    m.counter("ops").inc(5)
+    buf = _io.StringIO()
+    r = ScheduledReporter(m, 0.05, _console_emit(buf), "console")
+    try:
+        deadline = _time.time() + 5.0
+        while r.reports < 2 and _time.time() < deadline:
+            _time.sleep(0.02)
+    finally:
+        r.stop()
+    assert r.reports >= 2 and r.errors == 0
+    assert "ops: 5" in buf.getvalue()
+
+
+def test_scheduled_csv_reporter_appends_rows(tmp_path):
+    import csv as _csv
+    import time as _time
+
+    from titan_tpu.utils.metrics import (MetricManager, ScheduledReporter,
+                                         _csv_emit)
+
+    m = MetricManager()
+    m.counter("reads").inc(3)
+    m.timer("lat").update(2_000_000)
+    d = str(tmp_path / "mdir")
+    r = ScheduledReporter(m, 0.05, _csv_emit(d), "csv")
+    try:
+        deadline = _time.time() + 5.0
+        while r.reports < 2 and _time.time() < deadline:
+            _time.sleep(0.02)
+    finally:
+        r.stop()
+    rows = list(_csv.reader(open(d + "/metrics.csv")))
+    assert rows[0][0] == "timestamp"
+    data = [row for row in rows[1:] if row]
+    assert sum(1 for row in data if row[1] == "reads") >= 2
+    lat = next(row for row in data if row[1] == "lat")
+    assert float(lat[3]) == 2.0         # mean_ms
+
+
+def test_graphite_reporter_speaks_plaintext_protocol():
+    import socket
+    import threading as _threading
+    import time as _time
+
+    from titan_tpu.utils.metrics import (MetricManager, ScheduledReporter,
+                                         _graphite_emit)
+
+    got: list[bytes] = []
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+    stop = _threading.Event()
+
+    def accept_loop():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+            except socket.timeout:
+                continue
+            with c:
+                while chunk := c.recv(65536):
+                    got.append(chunk)
+
+    t = _threading.Thread(target=accept_loop, daemon=True)
+    t.start()
+    m = MetricManager()
+    m.counter("hits").inc(7)
+    r = ScheduledReporter(m, 0.05,
+                          _graphite_emit("127.0.0.1", port, "tt"),
+                          "graphite")
+    try:
+        deadline = _time.time() + 5.0
+        while r.reports < 1 and _time.time() < deadline:
+            _time.sleep(0.02)
+    finally:
+        r.stop()
+        stop.set()
+        t.join()
+        srv.close()
+    text = b"".join(got).decode()
+    line = next(ln for ln in text.splitlines() if ln)
+    name, value, ts = line.split()
+    assert name == "tt.hits" and value == "7" and ts.isdigit()
+
+
+def test_graph_wires_reporters_from_config(tmp_path):
+    import time as _time
+
+    import titan_tpu
+
+    d = str(tmp_path / "csvdir")
+    g = titan_tpu.open({"storage.backend": "inmemory",
+                        "metrics.enabled": True,
+                        "metrics.csv.interval-s": 0.05,
+                        "metrics.csv.directory": d})
+    try:
+        tx = g.new_transaction()
+        tx.add_vertex()
+        tx.commit()
+        deadline = _time.time() + 5.0
+        while not g._reporters[0].reports and _time.time() < deadline:
+            _time.sleep(0.02)
+        assert len(g._reporters) == 1
+        assert g._reporters[0].reports >= 1
+    finally:
+        g.close()
+    import os as _os
+    assert _os.path.exists(d + "/metrics.csv")
+    # close() stopped the thread
+    assert not g._reporters[0]._thread.is_alive()

@@ -278,6 +278,37 @@ BASIC_METRICS = ConfigOption(METRICS_NS, "enabled", "collect per-op metrics",
                              bool, False, Mutability.MASKABLE)
 METRICS_PREFIX = ConfigOption(METRICS_NS, "prefix", "metric name prefix", str,
                               "titan_tpu", Mutability.MASKABLE)
+# periodic background reporters (reference: per-reporter config
+# namespaces metrics.console/csv/ganglia/graphite with intervals,
+# GraphDatabaseConfiguration.java:1010-1226); interval 0 = reporter off
+METRICS_CONSOLE_NS = ConfigNamespace(METRICS_NS, "console",
+                                     "console metrics reporter")
+METRICS_CONSOLE_INTERVAL = ConfigOption(
+    METRICS_CONSOLE_NS, "interval-s",
+    "seconds between console metric reports (0 = off)", float, 0.0,
+    Mutability.MASKABLE, non_negative)
+METRICS_CSV_NS = ConfigNamespace(METRICS_NS, "csv",
+                                 "CSV metrics reporter")
+METRICS_CSV_INTERVAL = ConfigOption(
+    METRICS_CSV_NS, "interval-s",
+    "seconds between CSV metric snapshots (0 = off)", float, 0.0,
+    Mutability.MASKABLE, non_negative)
+METRICS_CSV_DIR = ConfigOption(
+    METRICS_CSV_NS, "directory",
+    "directory for timestamped CSV metric snapshots", str, "metrics-csv",
+    Mutability.MASKABLE)
+METRICS_GRAPHITE_NS = ConfigNamespace(METRICS_NS, "graphite",
+                                      "Graphite/Carbon metrics reporter")
+METRICS_GRAPHITE_INTERVAL = ConfigOption(
+    METRICS_GRAPHITE_NS, "interval-s",
+    "seconds between Graphite pushes (0 = off)", float, 0.0,
+    Mutability.MASKABLE, non_negative)
+METRICS_GRAPHITE_HOST = ConfigOption(
+    METRICS_GRAPHITE_NS, "host", "Graphite/Carbon plaintext host", str,
+    "localhost", Mutability.MASKABLE)
+METRICS_GRAPHITE_PORT = ConfigOption(
+    METRICS_GRAPHITE_NS, "port", "Graphite/Carbon plaintext port", int,
+    2003, Mutability.MASKABLE, positive)
 
 # --- computer / TPU OLAP -----------------------------------------------------
 COMPUTER_NS = ConfigNamespace(ROOT, "computer", "OLAP graph computer")

@@ -114,9 +114,17 @@ class StandardGraph:
         self._commit_lock = threading.Lock()
         self._metrics = None
         self._metrics_prefix = config.get(d.METRICS_PREFIX) or "titan_tpu"
+        self._reporters = []
         if config.get(d.BASIC_METRICS):
-            from titan_tpu.utils.metrics import MetricManager
+            from titan_tpu.utils.metrics import (MetricManager,
+                                                 start_reporters)
             self._metrics = MetricManager.instance()
+            # periodic background reporters (console/CSV/Graphite), each
+            # gated on its interval option; stopped at close(). Only
+            # started when collection is on — a reporter without
+            # metrics.enabled would dump empty (or another graph's)
+            # snapshots from the shared registry forever
+            self._reporters = start_reporters(config, self._metrics)
 
     # -- mixed index providers ----------------------------------------------
 
@@ -604,6 +612,8 @@ class StandardGraph:
         if not self._open:
             return
         self._open = False
+        for r in getattr(self, "_reporters", ()):
+            r.stop()
         try:
             self.backend.instance_registry.deregister(self.instance_id)
         except Exception:

@@ -350,6 +350,12 @@ def _write_graphson_stream(graph, f: TextIO, counts: dict) -> None:
 def read_graphson(graph, path: str, batch_size: int = 10_000) -> dict:
     """Import a write_graphson file. Two passes over the file: vertices
     (building the id remap), then edges. Returns counts."""
+    with open(path, "r", encoding="utf-8") as f:
+        first = f.readline()
+    if looks_like_tp3_graphson(first):
+        # a TinkerPop 3.0.2 adjacency-GraphSON file (the reference's
+        # data/*.json format) — accept it transparently
+        return read_graphson_tp3(graph, path, batch_size)
     loader = _Loader(graph, batch_size)
     with open(path, "r", encoding="utf-8") as f:
         header = json.loads(f.readline())
@@ -375,6 +381,125 @@ def read_graphson(graph, path: str, batch_size: int = 10_000) -> dict:
             for lb, ivid, ep in rec.get("outE", ()):
                 loader.add_edge(rec["id"], lb, ivid,
                                 {k: _dec(v) for k, v in ep.items()})
+        loader.flush()
+    return {"vertices": loader.vertices, "edges": loader.edges}
+
+
+# ---------------------------------------------------------------------------
+# TinkerPop 3.0.2 adjacency GraphSON (true wire compatibility)
+# ---------------------------------------------------------------------------
+# The reference embeds TinkerPop 3.0.2 (reference: pom.xml:62) whose
+# ``graph.io(IoCore.graphson()).writeGraph`` emits ONE untyped JSON object
+# per vertex in adjacency form — the exact shape of the files the
+# reference ships in titan-dist/src/assembly/static/data/
+# (tinkerpop-modern.json etc.):
+#
+#   {"id":1,"label":"person",
+#    "outE":{"knows":[{"id":7,"inV":2,"properties":{"weight":0.5}}]},
+#    "inE":{"created":[{"id":9,"outV":4,"properties":{...}}]},
+#    "properties":{"name":[{"id":0,"value":"marko"}]}}
+#
+# write_graphson_tp3/read_graphson_tp3 speak that format verbatim so
+# files interoperate with the TP3 ecosystem the reference lives in
+# (reference: graphdb/tinkerpop/TitanIoRegistry.java registers Titan's
+# serializers with TinkerPop's writers). Values that have no native JSON
+# representation (Geoshape, bytes, UUID, datetimes...) use the typed
+# {"@type","@value"} escape — the analog of the reference needing
+# TitanGraphSONModule for the same types. TP GraphSON carries NO schema:
+# import relies on the automatic schema maker, exactly like the
+# reference loading these files into a fresh graph.
+
+
+def write_graphson_tp3(graph, path: str) -> dict:
+    """Export in TinkerPop 3.0.2 adjacency GraphSON (see block comment).
+    Every edge appears twice (out-vertex's outE and in-vertex's inE),
+    matching TinkerPop's writer; empty sections are omitted."""
+    counts = {"vertices": 0, "edges": 0}
+    tx = graph.new_transaction(read_only=True)
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            for v in tx.vertices():
+                rec: dict = {"id": v.id, "label": v.label()}
+                out_e: dict = {}
+                for e in v.out_edges():
+                    out_e.setdefault(e.label(), []).append(
+                        {"id": e.rel.relation_id, "inV": e.in_vertex().id,
+                         **({"properties":
+                             {k: _enc(val) for k, val
+                              in e.property_map().items()}}
+                            if e.property_map() else {})})
+                in_e: dict = {}
+                for e in v.in_edges():
+                    in_e.setdefault(e.label(), []).append(
+                        {"id": e.rel.relation_id,
+                         "outV": e.out_vertex().id,
+                         **({"properties":
+                             {k: _enc(val) for k, val
+                              in e.property_map().items()}}
+                            if e.property_map() else {})})
+                props: dict = {}
+                for p in tx.vertex_properties(v.id):
+                    props.setdefault(p.key(), []).append(
+                        {"id": p.rel.relation_id, "value": _enc(p.value)})
+                if out_e:
+                    rec["outE"] = out_e
+                if in_e:
+                    rec["inE"] = in_e
+                if props:
+                    rec["properties"] = props
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                counts["vertices"] += 1
+                counts["edges"] += sum(len(x) for x in out_e.values())
+    finally:
+        tx.rollback()
+    return counts
+
+
+def looks_like_tp3_graphson(first_line: str) -> bool:
+    try:
+        rec = json.loads(first_line)
+    except (ValueError, TypeError):
+        return False
+    return (isinstance(rec, dict) and "id" in rec
+            and _GRAPHSON_MARKER not in rec
+            and ("outE" in rec or "inE" in rec or "properties" in rec
+                 or "label" in rec))
+
+
+def read_graphson_tp3(graph, path: str, batch_size: int = 10_000) -> dict:
+    """Import a TinkerPop 3.0.2 adjacency-GraphSON file (the reference's
+    data/*.json format). Edges are taken from ``outE`` only (each edge's
+    canonical appearance); ``inE`` entries are the mirrored copies and
+    are ignored. Vertex ids are remapped (as all importers here do)."""
+    loader = _Loader(graph, batch_size)
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            props = []
+            for key, plist in (rec.get("properties") or {}).items():
+                if isinstance(plist, list):
+                    for p in plist:
+                        props.append((key, _dec(p.get("value")), {}))
+                else:          # tolerate scalar shorthand
+                    props.append((key, _dec(plist), {}))
+            label = rec.get("label")
+            if label == "vertex":
+                label = None
+            loader.add_vertex(rec["id"], label, props)
+        loader.flush()
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            rec = json.loads(line)
+            for lb, elist in (rec.get("outE") or {}).items():
+                for e in elist:
+                    loader.add_edge(
+                        rec["id"], lb, e["inV"],
+                        {k: _dec(v) for k, v
+                         in (e.get("properties") or {}).items()})
         loader.flush()
     return {"vertices": loader.vertices, "edges": loader.edges}
 

@@ -25,17 +25,19 @@ The host drives levels AND the bottom-up sub-steps exactly like the
 single-chip hybrid: bu0 (candidate build + chunk-0 check) / bu_more
 (fused chunk rounds over the compacted survivors) / bu_exhaust (masked
 sweep of the stragglers), each dispatched at a power-of-two cap bucket
-sized from the PER-CHIP maxima read back in the stats vectors. The
-round-4 bench measured why this matters: the previous single fused
-bottom-up kernel ran every chunk round at full block width (c_cap =
-pow2(b_max)) and the exhaust at the full shard span (p_cap =
-pow2(q_max)), and a kernel pays its full cap in dead lanes — 121s vs
-2.3s for the plain hybrid at scale 23 on one device (PERF_NOTES.md
-round 4). With host-driven shrinking caps the sharded path costs the
-same kernel widths as the single-chip hybrid plus the O(frontier)
-exchange. The fused full-width kernel is kept only for multi-process
-(DCN) meshes, where host-side eager slicing of global arrays is not
-available.
+sized from the PER-CHIP maxima. The round-4 bench measured why this
+matters: the previous single fused bottom-up kernel ran every chunk
+round at full block width (c_cap = pow2(b_max)) and the exhaust at the
+full shard span (p_cap = pow2(q_max)), and a kernel pays its full cap
+in dead lanes — 121s vs 2.3s for the plain hybrid at scale 23 on one
+device (PERF_NOTES.md round 4). The same host-driven path serves
+single- AND multi-process (DCN) meshes (the reference contract: the
+distributed executor runs the SAME machinery as in-process —
+titan-hadoop HadoopScanMapper.java:33-110): the kernels return a
+REPLICATED pmax'd progress vector (so the host never indexes
+per-shard rows of a non-addressable global array), and cap trims of
+the sharded survivor lists run as jitted slices instead of eager
+numpy indexing.
 
 Per-shard edge arrays use LOCAL column indices, so each shard stays
 int32-safe as long as its own chunk count is < 2^31 — 8 shards of a
@@ -326,96 +328,19 @@ def _frontier_of_sh():
     return jit_once("shbfs_frontier_of", build)
 
 
-def _bu_fused():
+def _trim_cols():
     def build():
         import jax
-        import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
 
-        from titan_tpu.parallel.mesh import VERTEX_AXIS
-
-        @functools.partial(
-            jax.jit,
-            static_argnames=("mesh", "c_cap", "p_cap", "n_", "b_max",
-                             "rounds"))
-        def bu(dist, level, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh,
-               mesh, c_cap: int, p_cap: int, n_: int, b_max: int,
-               rounds: int):
-            """One FULLY-LOCAL bottom-up level in a single dispatch:
-            chunk rounds with early exit, then an exhaustive sweep for
-            stragglers, all at FULL block/shard width. Multi-process
-            (DCN) fallback only — the host-driven bu0/bu_more/bu_exhaust
-            path below is strictly cheaper but slices device arrays
-            eagerly, which needs addressable (single-process) arrays."""
-            def per_shard(dist, dstT_l, cs_l, degc_l, lo, hi):
-                dstT_l, cs_l, degc_l = dstT_l[0], cs_l[0], degc_l[0]
-                lo, hi = lo[0], hi[0]
-                # frontier bitmap: the dist replica is a 100MB+ table at
-                # bench scale (slow random-gather regime); the n/8-byte
-                # bitmap restores the fast regime — see bfs_hybrid module
-                # doc + experiments/gather_table_size.py
-                fbits = _pack_bits(dist, level, n_)
-                block = jnp.arange(b_max, dtype=jnp.int32)
-                cand_mask = (block < hi - lo) \
-                    & (dist[jnp.minimum(block + lo, n_)] >= INF) \
-                    & (degc_l > 0)
-                cand = jnp.nonzero(cand_mask, size=c_cap,
-                                   fill_value=b_max)[0].astype(jnp.int32)
-                nc = cand_mask.sum().astype(jnp.int32)
-                off = jnp.zeros((c_cap,), jnp.int32)
-                q_pad = dstT_l.shape[1] - 1
-
-                def round_(state, _):
-                    dist, cand, off, nc = state
-                    alive = jnp.arange(c_cap) < nc
-                    lv = jnp.clip(cand, 0, b_max - 1)
-                    cols = jnp.where(alive, cs_l[lv] + off, q_pad)
-                    parents = jnp.take(dstT_l,
-                                       jnp.clip(cols, 0, q_pad), axis=1)
-                    hit = _bit_of(fbits, parents)
-                    found = alive & hit.any(axis=0)
-                    gv = jnp.where(found, lv + lo, n_ + 1)
-                    dist = dist.at[gv].set(level + 1, mode="drop")
-                    surv = alive & ~found & (off + 1 < degc_l[lv])
-                    idx = jnp.nonzero(surv, size=c_cap,
-                                      fill_value=c_cap - 1)[0]
-                    nc2 = surv.sum().astype(jnp.int32)
-                    keep = jnp.arange(c_cap) < nc2
-                    cand = jnp.where(keep, cand[idx], b_max)
-                    off = jnp.where(keep, off[idx] + 1, 0)
-                    return (dist, cand, off, nc2), None
-
-                (dist, cand, off, nc), _ = jax.lax.scan(
-                    round_, (dist, cand, off, nc), None, length=rounds)
-
-                # exhaustive sweep for survivors
-                alive = jnp.arange(c_cap) < nc
-                lv = jnp.clip(cand, 0, b_max - 1)
-                rem = jnp.maximum(degc_l[lv] - off, 0)
-                cols, p_total, owner = enumerate_chunk_pairs(
-                    alive, rem, cs_l[lv] + off, p_cap, q_pad,
-                    with_owner=True)
-                parents = jnp.take(dstT_l, cols, axis=1)
-                hit = _bit_of(fbits, parents).any(axis=0)
-                j = jnp.arange(p_cap, dtype=jnp.int32)
-                found_per = jnp.zeros((c_cap,), jnp.int32) \
-                    .at[jnp.where(j < p_total, owner, c_cap - 1)] \
-                    .max(hit.astype(jnp.int32), mode="drop")
-                found = alive & (found_per > 0)
-                gv = jnp.where(found, lv + lo, n_ + 1)
-                dist = dist.at[gv].set(level + 1, mode="drop")
-
-                return dist[None]
-
-            return jax.shard_map(
-                per_shard, mesh=mesh,
-                in_specs=(P(), P(VERTEX_AXIS, None, None),
-                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
-                          P(VERTEX_AXIS), P(VERTEX_AXIS)),
-                out_specs=P(VERTEX_AXIS, None), check_vma=False,
-            )(dist, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
-        return bu
-    return jit_once("shbfs_bu", build)
+        @functools.partial(jax.jit, static_argnames=("c2",))
+        def trim(a, c2: int):
+            """Cap-trim a [D, cap] sharded array to [D, c2] ON DEVICE —
+            eager numpy slicing of a non-addressable global array raises
+            in multi-process meshes; a jitted slice along the unsharded
+            axis preserves the shard layout and works on any mesh."""
+            return a[:, :c2]
+        return trim
+    return jit_once("shbfs_trim", build)
 
 
 def _bu_start_sh():
@@ -435,7 +360,10 @@ def _bu_start_sh():
             survivors compacted under lax.cond (skipped at heavy levels
             where chunk 0 decides everyone — the single-chip hybrid
             measured the unconditional compaction at ~2.5s). Returns
-            per-chip (dist, fbits, cand, off, prog=[nc, rem8]).
+            per-chip (dist, fbits, cand, off, prog=[nc, rem8]) plus a
+            REPLICATED pmax'd [nc_max, rem8_max] the host can read on
+            any mesh (multi-process included — per-shard rows of a
+            global array are not host-addressable there).
             Caller guarantee: per-chip candidate count <= c_cap (sized
             from the exchange's nunv_chip pmax)."""
             def per_shard(dist, dstT_l, cs_l, degc_l, lo, hi):
@@ -479,15 +407,19 @@ def _bu_start_sh():
 
                 cand2, off2, rem8 = jax.lax.cond(
                     nc > 0, compact, no_compact, None)
+                prog_max = jnp.stack(
+                    [jax.lax.pmax(nc, VERTEX_AXIS),
+                     jax.lax.pmax(rem8, VERTEX_AXIS)])
                 return (dist[None], fbits[None], cand2[None], off2[None],
-                        jnp.stack([nc, rem8])[None])
+                        jnp.stack([nc, rem8])[None], prog_max)
 
             return jax.shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(P(), P(VERTEX_AXIS, None, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS), P(VERTEX_AXIS)),
-                out_specs=(P(VERTEX_AXIS, None),) * 5, check_vma=False,
+                out_specs=(P(VERTEX_AXIS, None),) * 5 + (P(),),
+                check_vma=False,
             )(dist, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
         return bu0
     return jit_once("shbfs_bu0", build)
@@ -548,8 +480,11 @@ def _bu_more_sh():
                 rem = jnp.where(alive,
                                 jnp.maximum(degc_l[lv] - off, 0), 0) \
                     .sum(dtype=jnp.int32)
+                prog_max = jnp.stack(
+                    [jax.lax.pmax(c_count, VERTEX_AXIS),
+                     jax.lax.pmax(rem, VERTEX_AXIS)])
                 return (dist[None], cand[None], off[None],
-                        jnp.stack([c_count, rem])[None])
+                        jnp.stack([c_count, rem])[None], prog_max)
 
             return jax.shard_map(
                 per_shard, mesh=mesh,
@@ -558,7 +493,8 @@ def _bu_more_sh():
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS),
                           P(VERTEX_AXIS, None, None)),
-                out_specs=(P(VERTEX_AXIS, None),) * 4, check_vma=False,
+                out_specs=(P(VERTEX_AXIS, None),) * 4 + (P(),),
+                check_vma=False,
             )(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, colstart_sh,
               degc_sh, lo_sh, dstT_sh)
         return bu
@@ -715,41 +651,35 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
                          dev_scalar(level), dstT_sh, colstart_sh,
                          degc_sh, lo_sh, hi_sh, mesh=mesh,
                          f_cap=f_cap, p_cap=p_cap, n_=n, b_max=b_max)
-        elif multiproc:
-            # DCN fallback: one fused full-width dispatch (host-side
-            # eager slicing of global arrays is unavailable)
-            bu = _bu_fused()
-            dist_sh = bu(dist, dev_scalar(level), dstT_sh,
-                         colstart_sh, degc_sh, lo_sh, hi_sh,
-                         mesh=mesh, c_cap=cap_b, p_cap=cap_q, n_=n,
-                         b_max=b_max, rounds=BU_CHUNK_ROUNDS)
         else:
             # host-driven bottom-up: bu0 / fused bu_more rounds /
-            # exhaust, each at the per-chip cap bucket (see module doc)
+            # exhaust, each at the per-chip cap bucket (see module doc).
+            # Single- AND multi-process: the host only ever reads the
+            # REPLICATED pmax'd progress vector, and cap trims run as
+            # jitted slices (r4's fused full-width DCN fallback — 52x
+            # slower at scale 23 — is deleted).
             bu0 = _bu_start_sh()
             bu_more = _bu_more_sh()
             bu_ex = _bu_exhaust_sh()
+            trim = _trim_cols()
             c_cap = min(_next_pow2(max(nunv_chip, 2)), cap_b)
-            dist_sh, fbits_sh, cand_sh, off_sh, prog_sh = bu0(
+            dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, prog_max = bu0(
                 dist, dev_scalar(level), dstT_sh, colstart_sh, degc_sh,
                 lo_sh, hi_sh, mesh=mesh, c_cap=c_cap, n_=n, b_max=b_max)
-            prog = np.asarray(prog_sh)
-            nc_max = int(prog[:, 0].max())
-            rem8_max = int(prog[:, 1].max())
+            nc_max, rem8_max = (int(x) for x in np.asarray(prog_max))
             bu_trail.append({"step": "bu0", "c_cap": c_cap,
                              "nc_max": nc_max})
             if nc_max > 0:
                 # one fused dispatch covers the remaining chunk rounds
                 # (bu0 already consumed chunk 0) at the survivor cap
                 c2 = min(_next_pow2(max(nc_max, 2)), c_cap)
-                dist_sh, cand_sh, off_sh, prog_sh = bu_more(
-                    dist_sh, fbits_sh, cand_sh[:, :c2], off_sh[:, :c2],
-                    prog_sh, dev_scalar(level), colstart_sh, degc_sh,
-                    lo_sh, dstT_sh, mesh=mesh, c_cap=c2, n_=n,
-                    b_max=b_max, fuse=BU_CHUNK_ROUNDS - 1)
-                prog = np.asarray(prog_sh)
-                nc_max = int(prog[:, 0].max())
-                rem8_max = int(prog[:, 1].max())
+                dist_sh, cand_sh, off_sh, prog_sh, prog_max = bu_more(
+                    dist_sh, fbits_sh, trim(cand_sh, c2=c2),
+                    trim(off_sh, c2=c2), prog_sh, dev_scalar(level),
+                    colstart_sh, degc_sh, lo_sh, dstT_sh, mesh=mesh,
+                    c_cap=c2, n_=n, b_max=b_max,
+                    fuse=BU_CHUNK_ROUNDS - 1)
+                nc_max, rem8_max = (int(x) for x in np.asarray(prog_max))
                 bu_trail.append({"step": "bu_more", "c_cap": c2,
                                  "fuse": BU_CHUNK_ROUNDS - 1,
                                  "nc_max": nc_max})
@@ -757,10 +687,10 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
                 c2 = min(_next_pow2(max(nc_max, 2)), c_cap)
                 p2 = min(_next_pow2(max(rem8_max, 2)), cap_q)
                 dist_sh = bu_ex(
-                    dist_sh, fbits_sh, cand_sh[:, :c2], off_sh[:, :c2],
-                    prog_sh, dev_scalar(level), colstart_sh, degc_sh,
-                    lo_sh, dstT_sh, mesh=mesh, c_cap=c2, p_cap=p2,
-                    n_=n, b_max=b_max)
+                    dist_sh, fbits_sh, trim(cand_sh, c2=c2),
+                    trim(off_sh, c2=c2), prog_sh, dev_scalar(level),
+                    colstart_sh, degc_sh, lo_sh, dstT_sh, mesh=mesh,
+                    c_cap=c2, p_cap=p2, n_=n, b_max=b_max)
                 bu_trail.append({"step": "bu_exhaust", "c_cap": c2,
                                  "p_cap": p2})
         # device-sized exchange: dispatch with the adaptive guess cap and

@@ -124,46 +124,14 @@ def _wrap_plan(kind: str):
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
-                           static_argnames=("n_", "k_max", "budget",
-                                            "quantile_mass", "bins"))
+                           static_argnames=("n_", "k_max", "budget"))
         def wrapplan(val, val_exp, degc, bucket_end, n_: int, k_max: int,
-                     budget: int, quantile_mass: int = 0,
-                     bins: int = 512):
+                     budget: int):
+            # plain / delta-stepping plan; the priority-batched
+            # (quantile) mode has its own merged single-dispatch plan,
+            # _quant_plan
             hasdeg = degc[:n_] > 0
             changed = (val[:n_] < val_exp[:n_]) & hasdeg
-            if quantile_mass:
-                # priority-batched threshold (approximate Dijkstra):
-                # histogram the improved vertices' values and pick the
-                # smallest threshold whose in-band chunk mass reaches
-                # ``quantile_mass`` — expansion happens in near-sorted
-                # value order, so a vertex is rarely re-expanded (the
-                # Dijkstra no-re-expansion property, batched). This is
-                # NOT delta-stepping: the band adapts to wherever the
-                # mass is, so the power-law one-bucket collapse
-                # (PERF_NOTES r4) cannot happen.
-                big_ = jnp.asarray(
-                    FINF if val.dtype == jnp.float32 else IINF,
-                    val.dtype)
-                vals = jnp.where(changed, val[:n_], big_)
-                lo = vals.min()
-                hi0 = jnp.where(changed, val[:n_],
-                                -big_ if val.dtype == jnp.float32
-                                else -IINF).max()
-                span = jnp.maximum(hi0 - lo, 1e-30)
-                b = jnp.clip(((val[:n_] - lo) / span
-                              * bins).astype(jnp.int32), 0, bins - 1)
-                hist = jnp.zeros((bins,), jnp.int32).at[
-                    jnp.where(changed, b, bins - 1)].add(
-                    jnp.where(changed, degc[:n_], 0), mode="drop")
-                cum = jnp.cumsum(hist)
-                pick = jnp.searchsorted(
-                    cum, jnp.int32(quantile_mass), side="left")
-                pick = jnp.minimum(pick, bins - 1)
-                thr = lo + span * (pick + 1).astype(val.dtype) / bins
-                # strict `val < thr` must include the minimum bin even
-                # when the band has collapsed to a point
-                thr = jnp.maximum(thr, jnp.nextafter(lo, big_))
-                bucket_end = thr
             inb = changed & (val[:n_] < bucket_end)
             nf = inb.sum().astype(jnp.int32)
             cummass = jnp.cumsum(
@@ -265,37 +233,85 @@ def _push_slice(kind: str):
     return jit_once(f"frontier_push_{kind}", build)
 
 
-def _list_plan(kind: str):
-    """Quantile-mode round prep: compact the in-band improved vertices
-    into a LIST and mass-balance it into segment bounds. The vertex-
-    range push slicing pays ceil(n / 2^23) windows per slice even when
-    the band is tiny and scattered (measured scale-26: ~295s despite a
-    3.9x relaxation-mass cut — dispatch-bound); the list path dispatches
-    ONE push per ~budget chunks of actual mass."""
+def _quant_plan(kind: str):
+    """Quantile-mode round plan in ONE dispatch: 2-level histogram
+    threshold + in-band list compaction + mass-balanced segment bounds
+    (r4 split this across two kernels — threshold in the wrap plan,
+    list build in a second dispatch — paying an extra n-scale pass and
+    a dispatch/sync per round, ~0.4s of the measured ~2s/round overhead
+    at scale 26). ``f_cap`` is a FIXED
+    module-level width (one compile bucket); an in-band set larger than
+    f_cap is truncated by the nonzero, which is SOUND: unlisted vertices
+    stay improved (val < val_exp) and the next round re-plans them."""
     def build():
         import jax
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
                            static_argnames=("n_", "f_cap", "k_max",
-                                            "budget"))
-        def listplan(val, val_exp, degc, thr, n_: int, f_cap: int,
-                     k_max: int, budget: int):
-            inb = (val[:n_] < val_exp[:n_]) & (degc[:n_] > 0) \
-                & (val[:n_] < thr)
+                                            "budget", "quantile_mass",
+                                            "bins"))
+        def qplan(val, val_exp, degc, n_: int, f_cap: int, k_max: int,
+                  budget: int, quantile_mass: int, bins: int = 512):
+            hasdeg = degc[:n_] > 0
+            changed = (val[:n_] < val_exp[:n_]) & hasdeg
+            big_ = jnp.asarray(FINF, val.dtype)
+            vals = jnp.where(changed, val[:n_], big_)
+            lo = vals.min()
+            hi0 = jnp.where(changed, val[:n_], -big_).max()
+            span = jnp.maximum(hi0 - lo, 1e-30)
+            mass = jnp.where(changed, degc[:n_], 0)
+            b = jnp.clip(((val[:n_] - lo) / span
+                          * bins).astype(jnp.int32), 0, bins - 1)
+            b = jnp.where(changed, b, bins - 1)
+            hist = jnp.zeros((bins,), jnp.int32).at[b].add(mass,
+                                                          mode="drop")
+            cum = jnp.cumsum(hist)
+            pick = jnp.minimum(jnp.searchsorted(
+                cum, jnp.int32(quantile_mass), side="left"), bins - 1)
+            lo2 = lo + span * pick.astype(val.dtype) / bins
+            span2 = span / bins
+            before = jnp.where(pick > 0, cum[jnp.maximum(pick - 1, 0)], 0)
+            in2 = changed & (b == pick)
+            b2 = jnp.clip(((val[:n_] - lo2) / span2
+                           * bins).astype(jnp.int32), 0, bins - 1)
+            hist2 = jnp.zeros((bins,), jnp.int32).at[
+                jnp.where(in2, b2, bins - 1)].add(
+                jnp.where(in2, degc[:n_], 0), mode="drop")
+            cum2 = jnp.cumsum(hist2)
+            pick2 = jnp.minimum(jnp.searchsorted(
+                cum2, jnp.int32(quantile_mass) - before, side="left"),
+                bins - 1)
+            thr = lo2 + span2 * (pick2 + 1).astype(val.dtype) / bins
+            thr = jnp.maximum(thr, jnp.nextafter(lo, big_))
+
+            inb = changed & (val[:n_] < thr)
             flist = jnp.nonzero(inb, size=f_cap,
                                 fill_value=n_)[0].astype(jnp.int32)
             valid = flist < n_
+            nf = valid.sum().astype(jnp.int32)
             degl = jnp.where(valid, degc[jnp.minimum(flist, n_)], 0)
             cmass = jnp.cumsum(degl.astype(jnp.int32))
+            m8 = cmass[-1]                       # LISTED mass
             targets = jnp.arange(1, k_max + 1, dtype=jnp.int32) * budget
             lb = jnp.concatenate(
                 [jnp.zeros((1,), jnp.int32),
-                 jnp.searchsorted(cmass, targets,
-                                  side="right").astype(jnp.int32)])
-            return flist, jnp.minimum(lb, jnp.int32(f_cap))
-        return listplan
-    return jit_once(f"frontier_listplan_{kind}", build)
+                 jnp.minimum(jnp.searchsorted(cmass, targets,
+                                              side="right"),
+                             f_cap).astype(jnp.int32)])
+            pending = changed & ~inb
+            pmin = jnp.min(jnp.where(pending, val[:n_], big_))
+            stats = jnp.concatenate(
+                [jnp.stack([nf, m8]),
+                 jax.lax.bitcast_convert_type(pmin, jnp.int32)[None]])
+            return stats, flist, lb, jnp.asarray(thr, val.dtype)
+        return qplan
+    return jit_once(f"frontier_quantplan_{kind}", build)
+
+
+# fixed in-band list width for the merged quantile plan (one compile
+# bucket; truncation is sound — see _quant_plan)
+QUANT_LIST_CAP = 1 << 23
 
 
 def _push_list(kind: str):
@@ -376,13 +392,15 @@ def _max_degc(g) -> int:
 # width trades dispatch count against the src_val gather table size
 # (2^23 int32 = 32MB, the last fast-gather size — see PERF_NOTES.md)
 SLICE_WIDTH = 1 << 23
-# default per-round band mass (chunks) for quantile-batched SSSP when
-# explicitly requested. Default is OFF: measured scale-26 (warm, same
-# chip-day): plain 247s / 1118M chunks vs quantile 350s / 497M chunks —
-# the 2.25x relaxation-mass cut is real but per-round dispatch floors
-# (~0.3-1.2s per kernel through the axon tunnel, x ~6 dispatches x 27
-# rounds) outweigh it on tunnel-attached hardware. Revisit on directly-
-# attached chips where dispatch costs are ~10x lower.
+# default per-round band mass (chunks) for quantile-batched SSSP — the
+# measured r5 winner and the DEFAULT mode: scale-26 warm, same chip-day:
+# plain 247s / 1118M chunks vs quantile-2^24 121-130s / 394M chunks
+# (after the r5 fixes: two-level threshold so one histogram bin cannot
+# swallow 10x the target mass, pow-4 f_cap buckets so band sizes stop
+# compiling fresh kernels, and the merged single-dispatch _quant_plan).
+# Band-size sweep: 2^23 = 45 rounds (per-round floors dominate), 2^24 =
+# 31 rounds/394M, 2^25 = 30/518M, 2^26 = 28/716M — rounds are
+# WAVE-limited below 2^24, re-expansion grows above it.
 QUANTILE_MASS_DEFAULT = 1 << 24
 
 
@@ -439,11 +457,69 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
     dtname = "float32" if is_f32 else "int32"
     prev_sig = None
     escalate = False
+    qf_cap = min(QUANT_LIST_CAP, w_max)
     while rounds < max_rounds:          # collect (bucket_end, nf, m8)
+        if quantile_mass:
+            # priority-batched mode: ONE merged plan dispatch
+            # (threshold + in-band list + segment bounds, _quant_plan)
+            # then a pushl per ~budget chunks of listed mass. Expansion
+            # happens in near-sorted value order — the Dijkstra
+            # no-re-expansion property, batched; exactness is
+            # val_exp-tracked and does not depend on the threshold.
+            qplan = _quant_plan(kind)
+            pushl = _push_list(kind)
+            stats, flist, lbounds, thr_dev = qplan(
+                val, val_exp, degc, n_=n, f_cap=qf_cap,
+                k_max=SLICE_K_MAX, budget=budget,
+                quantile_mass=quantile_mass)
+            st_h = np.asarray(stats)       # ONE sync per round
+            nf, m8 = int(st_h[0]), int(st_h[1])
+            pmin = st_h[2:3].view(np.float32)[0]
+            if trace is not None:
+                import time as _t
+                trace.append((0.0, nf, m8, _t.time()))
+            if nf == 0 or m8 == 0:
+                if float(pmin) >= big * (1 - 1e-6):
+                    return val[:n], rounds   # no pending work anywhere
+                # the device threshold always includes the minimum
+                # value, so an empty round with pending work cannot
+                # recur — guard fp corner-cases by escalating to plain
+                quantile_mass = 0
+                continue
+            sig_q = (nf, m8, float(pmin))
+            if sig_q == prev_sig:
+                # two identical rounds = every member was fits-deferred
+                # (pathological segment packing) — permanently fall
+                # back to the vertex-range path, whose escalate
+                # handling is proven
+                quantile_mass = 0
+                continue
+            prev_sig = sig_q
+            nseg = min(-(-m8 // budget), SLICE_K_MAX)
+            # f bucket quantized to powers of FOUR: per-nf pow2 buckets
+            # compiled a fresh kernel per distinct band size (measured
+            # scale 26: seven one-call pushlist compiles at ~17s each
+            # through the remote-compile tunnel — more compile than
+            # push). A segment holds at most ~budget vertices.
+            f_bucket = _quantize_cap(min(nf, budget + max_dc), qf_cap)
+            for k in range(nseg):
+                # +max_dc headroom: a vertex straddling the mass target
+                # lands wholly in one segment (full segments then size
+                # to exactly p_full — the budget is pre-shaved by
+                # max_dc, see above)
+                mass_k = min(budget, m8 - k * budget) + max_dc
+                p_cap = _quantize_cap(mass_k, p_full)
+                fk = min(f_bucket, p_cap)
+                val, val_exp = pushl(
+                    val, val_exp, flist, lbounds, dev_scalar(k),
+                    thr_dev, dstT, colstart, degc, wp,
+                    f_cap=fk, p_cap=p_cap, n_=n)
+            rounds += 1
+            continue
         be_dev = dev_scalar(bucket_end, dtname)
         plan, bounds_dev, thr_dev = wrapplan(
             val, val_exp, degc, be_dev, n_=n, k_max=SLICE_K_MAX,
-            budget=budget, quantile_mass=quantile_mass)
+            budget=budget)
         plan_h = np.asarray(plan)          # ONE sync per round
         nf, m8 = (int(x) for x in plan_h[:2])
         bounds = plan_h[2:2 + SLICE_K_MAX + 1]
@@ -455,49 +531,10 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
         if nf == 0 or m8 == 0:
             if float(pmin) >= big * (1 - 1e-6):
                 return val[:n], rounds     # no pending work anywhere
-            if quantile_mass:
-                # the device threshold always includes the minimum bin,
-                # so an empty round with pending work cannot recur —
-                # but guard against fp corner-cases by escalating to a
-                # full round
-                quantile_mass = 0
-                continue
             # bucket drained: advance to the minimum pending value's
             # bucket (strictly increases — pmin >= current bucket_end)
             bucket_end = float((np.floor(float(pmin) / delta) + 1)
                                * delta)
-            continue
-        sig_q = (nf, m8, float(pmin))
-        if quantile_mass and sig_q == prev_sig:
-            # two identical rounds = every member was fits-deferred
-            # (pathological segment packing) — permanently fall back to
-            # the vertex-range path, whose escalate handling is proven
-            quantile_mass = 0
-        prev_sig = sig_q if quantile_mass else prev_sig
-        if quantile_mass:
-            # list path: compact the (small, scattered) band once and
-            # push mass-balanced segments — one dispatch per ~budget
-            # chunks instead of ceil(n/width) windows per slice
-            listplan = _list_plan(kind)
-            pushl = _push_list(kind)
-            f_cap = min(_next_pow2(max(nf, 2)), w_max)
-            flist, lbounds = listplan(val, val_exp, degc, thr_dev,
-                                      n_=n, f_cap=f_cap,
-                                      k_max=SLICE_K_MAX, budget=budget)
-            nseg = min(-(-m8 // budget), SLICE_K_MAX)
-            for k in range(nseg):
-                # +max_dc headroom: a vertex straddling the mass target
-                # lands wholly in one segment (full segments then size
-                # to exactly p_full — the budget is pre-shaved by
-                # max_dc, see above)
-                mass_k = min(budget, m8 - k * budget) + max_dc
-                p_cap = _quantize_cap(mass_k, p_full)
-                fk = min(f_cap, p_cap)
-                val, val_exp = pushl(
-                    val, val_exp, flist, lbounds, dev_scalar(k),
-                    thr_dev, dstT, colstart, degc, wp,
-                    f_cap=fk, p_cap=p_cap, n_=n)
-            rounds += 1
             continue
         # a round that changed NOTHING means every remaining member was
         # fits-deferred (its chunk range exceeded the tight p_cap) —
@@ -558,11 +595,13 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
     if delta is None:
         delta = 0.0
     if quantile_mass is None:
-        # default: the plain expand-everything-improved frontier — the
-        # measured winner on tunnel-attached chips (see
-        # QUANTILE_MASS_DEFAULT). Pass quantile_mass=QUANTILE_MASS_
-        # DEFAULT (or any band mass) for priority-batched expansion.
-        quantile_mass = 0
+        # default: priority-batched expansion at the measured-optimal
+        # band mass (see QUANTILE_MASS_DEFAULT — 2x faster than the
+        # plain improved-set frontier at scale 26). Pass 0 for the
+        # plain expand-everything frontier, or delta>0 for
+        # delta-stepping buckets (spread distance distributions).
+        quantile_mass = 0 if delta and delta > 0 \
+            else QUANTILE_MASS_DEFAULT
     val = jnp.full((n + 1,), FINF, jnp.float32).at[source_dense].set(0.0)
     # nothing has pushed yet: only the source reads as improved
     # (val < val_exp); unreached vertices sit at val == val_exp == FINF

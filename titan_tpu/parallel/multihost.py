@@ -185,16 +185,25 @@ def _worker(coordinator: str, num_processes: int, process_id: int,
     mesh = global_mesh()
     dist, levels = run_multihost_bfs(hg, source, mesh)
     if process_id == 0:
+        from titan_tpu.models import bfs_hybrid_sharded as S
         ref, _ = frontier_bfs_hybrid(snap, source)
         ok = bool((dist == np.asarray(ref)).all())
+        # bottom-up levels must run through the HOST-DRIVEN
+        # bu0/bu_more/exhaust path on the process-spanning mesh too
+        # (r4 kept a fused full-width DCN fallback measured 52x slower;
+        # it is deleted — this records the proof)
+        bu_levels = [p for p in S.LAST_PROFILE if p["mode"] == "bu"]
         print("MULTIHOST_OK " + json.dumps({
             "processes": num_processes,
             "devices": jax.device_count(),
             "local_devices": jax.local_device_count(),
             "scale": scale, "levels": levels,
             "reached": int((dist < (1 << 30)).sum()),
-            "bit_equal_vs_single_chip": ok}), flush=True)
-        if not ok:
+            "bit_equal_vs_single_chip": ok,
+            "bu_levels_host_driven": len(bu_levels),
+            "bu_trails": [p["bu_trail"] for p in bu_levels]}),
+            flush=True)
+        if not ok or not bu_levels:
             raise SystemExit(2)
 
 

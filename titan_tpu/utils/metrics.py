@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import csv
 import io
+import os
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -160,6 +162,118 @@ class MetricManager:
                                 f"{val['min_ms']:.6f}", f"{val['max_ms']:.6f}"])
                 else:
                     w.writerow([name, val, "", "", ""])
+
+
+class ScheduledReporter:
+    """Background daemon thread that emits a metrics snapshot every
+    ``interval_s`` seconds (reference: the Dropwizard scheduled
+    reporters configured per namespace —
+    GraphDatabaseConfiguration.java:1010-1226). ``emit`` receives
+    (manager, timestamp); exceptions are swallowed after counting
+    (a dead sink must not take the graph down)."""
+
+    def __init__(self, manager: "MetricManager", interval_s: float,
+                 emit, name: str = "reporter"):
+        self.manager = manager
+        self.interval_s = interval_s
+        self.emit = emit
+        self.name = name
+        self.errors = 0
+        self.reports = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"metrics-{name}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.report_now()
+
+    def report_now(self) -> None:
+        try:
+            self.emit(self.manager, time.time())
+            self.reports += 1
+        except Exception:
+            self.errors += 1
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout)
+
+
+def _console_emit(stream=None):
+    def emit(manager, ts):
+        out = stream or sys.stderr
+        out.write(f"== metrics @ {ts:.0f} ==\n")
+        manager.report_console(out)
+    return emit
+
+
+def _csv_emit(directory: str):
+    def emit(manager, ts):
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, "metrics.csv")
+        fresh = not os.path.exists(path)
+        with open(path, "a", newline="") as f:
+            w = csv.writer(f)
+            if fresh:
+                w.writerow(["timestamp", "metric", "count", "mean_ms",
+                            "min_ms", "max_ms"])
+            for name, val in manager.snapshot().items():
+                if isinstance(val, dict):
+                    w.writerow([f"{ts:.3f}", name, val["count"],
+                                f"{val['mean_ms']:.6f}",
+                                f"{val['min_ms']:.6f}",
+                                f"{val['max_ms']:.6f}"])
+                else:
+                    w.writerow([f"{ts:.3f}", name, val, "", "", ""])
+    return emit
+
+
+def _graphite_emit(host: str, port: int, prefix: str):
+    def emit(manager, ts):
+        import socket
+
+        lines = []
+        t = int(ts)
+        for name, val in manager.snapshot().items():
+            key = f"{prefix}.{name}".replace(" ", "_")
+            if isinstance(val, dict):
+                lines.append(f"{key}.count {val['count']} {t}\n")
+                lines.append(f"{key}.mean_ms {val['mean_ms']:.6f} {t}\n")
+                lines.append(f"{key}.max_ms {val['max_ms']:.6f} {t}\n")
+            else:
+                lines.append(f"{key} {val} {t}\n")
+        with socket.create_connection((host, port), timeout=5.0) as s:
+            s.sendall("".join(lines).encode())
+    return emit
+
+
+def start_reporters(config, manager: Optional["MetricManager"] = None
+                    ) -> list[ScheduledReporter]:
+    """Start every reporter whose interval option is > 0 (the graph
+    calls this at open and stops them at close)."""
+    from titan_tpu.config import defaults as d
+
+    manager = manager or MetricManager.instance()
+    prefix = config.get(d.METRICS_PREFIX)
+    out: list[ScheduledReporter] = []
+    iv = config.get(d.METRICS_CONSOLE_INTERVAL)
+    if iv > 0:
+        out.append(ScheduledReporter(manager, iv, _console_emit(),
+                                     "console"))
+    iv = config.get(d.METRICS_CSV_INTERVAL)
+    if iv > 0:
+        out.append(ScheduledReporter(
+            manager, iv, _csv_emit(config.get(d.METRICS_CSV_DIR)), "csv"))
+    iv = config.get(d.METRICS_GRAPHITE_INTERVAL)
+    if iv > 0:
+        out.append(ScheduledReporter(
+            manager, iv,
+            _graphite_emit(config.get(d.METRICS_GRAPHITE_HOST),
+                           config.get(d.METRICS_GRAPHITE_PORT), prefix),
+            "graphite"))
+    return out
 
 
 class _OpRecorder:
