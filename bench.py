@@ -58,7 +58,9 @@ _EST = {
     "bfs26": 600,        # 9GB upload + compiles + 3 reps x ~12s
     "ssspwcc": 300,      # frontier SSSP + BFS-seeded WCC
     "pagerank": 120,     # 0.6GB upload + compile + 12 iterations
-    "store_ingest": 300,  # bulk ingest s22 + native scan + CSR + BFS
+    "store_ingest": 400,  # packed bulk ingest s22 + native packed scan
+                          # + CSR + BFS (measured s20: 54s end-to-end;
+                          # s22 projects ~310s + compile headroom)
     "bfs_heavy": 450,    # ~10GB upload + 2 reps (graph pre-built on disk)
 }
 

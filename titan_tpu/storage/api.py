@@ -147,6 +147,13 @@ class StoreFeatures:
     persists: bool = True
     cell_ttl: bool = False
     timestamps: bool = False
+    # packed bulk row IO (mutate_row_packed / scan_rows_packed): the
+    # per-Entry SPI costs ~3-4us of host Python per cell, which
+    # dominates benchmark-scale ingest and snapshot scans (measured
+    # scale 22: 324s ingest + 238s scan through the entry-wise path);
+    # stores that can move whole rows as (columns, values) byte-string
+    # lists declare this and the bulk loader / snapshot scan use it
+    packed_ops: bool = False
 
     @property
     def scan(self) -> bool:
@@ -196,6 +203,29 @@ class KeyColumnValueStore(abc.ABC):
     @abc.abstractmethod
     def mutate(self, key: bytes, additions: Sequence[Entry],
                deletions: Sequence[bytes], txh: StoreTransaction) -> None: ...
+
+    def mutate_row_packed(self, key: bytes, columns: Sequence[bytes],
+                          values: Sequence[bytes],
+                          txh: StoreTransaction) -> None:
+        """OPTIONAL bulk-row upsert (features.packed_ops): semantically
+        identical to ``mutate(key, [Entry(c, v) ...], [])`` but takes
+        parallel byte-string lists with ``columns`` PRE-SORTED ascending
+        (the caller's contract), letting stores adopt whole fresh rows
+        without per-Entry work. Ownership of the sequences TRANSFERS to
+        the store — callers must not mutate them afterwards. Default:
+        entry-wise fallback."""
+        self.mutate(key, [Entry(c, v) for c, v in zip(columns, values)],
+                    [], txh)
+
+    def scan_rows_packed(self, txh: StoreTransaction) -> Iterator:
+        """OPTIONAL full ordered scan yielding ``(key, columns, values)``
+        with parallel byte-string lists instead of EntryLists
+        (features.packed_ops) — the snapshot scan's bulk path. The
+        yielded lists are READ-ONLY views of store internals; callers
+        must not mutate them or write to the store while iterating.
+        Default: adapt get_keys."""
+        for key, entries in self.get_keys(SliceQuery(), txh):
+            yield key, [e.column for e in entries], [e.value for e in entries]
 
     def acquire_lock(self, key: bytes, column: bytes, expected: Optional[bytes],
                      txh: StoreTransaction) -> None:
