@@ -17,16 +17,3 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-
-def pytest_load_initial_conftests(early_config, parser, args):
-    """Default the suite onto 4 xdist workers (687s -> 214s measured)
-    WITHOUT hard-requiring the plugin: plain pytest keeps working when
-    pytest-xdist is absent, and an explicit -n/--numprocesses wins."""
-    if any(a == "-n" or a.startswith("-n") or a.startswith("--numprocesses")
-           or a == "no:xdist" for a in args):
-        return
-    try:
-        import xdist  # noqa: F401
-    except ImportError:
-        return
-    args += ["-n", "4"]

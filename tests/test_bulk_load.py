@@ -7,7 +7,6 @@ import jax.numpy as jnp
 import numpy as np
 
 import titan_tpu
-import titan_tpu.core.defs
 from titan_tpu.storage.api import KeySliceQuery
 from titan_tpu.codec.dataio import ReadBuffer
 from titan_tpu.core.defs import Direction, RelationCategory
@@ -159,8 +158,7 @@ def test_bulk_packed_rows_slice_correctly():
             colbytes = [e.column for e in full]
             assert colbytes == sorted(colbytes)
             # type-sliced edge read must return exactly this row's edges
-            [q] = g.codec.query_type(st.id, titan_tpu.core.defs
-                                     .Direction.OUT, g.schema)
+            [q] = g.codec.query_type(st.id, Direction.OUT, g.schema)
             edges = store.get_slice(KeySliceQuery(key, q), txh)
             want = int((src == i).sum())
             assert len(edges) == want

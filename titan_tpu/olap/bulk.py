@@ -194,10 +194,11 @@ def bulk_load_adjacency(graph, src: np.ndarray, dst: np.ndarray,
     txh = graph.backend.manager.begin_transaction()
     empty_val = b"\x80"          # uvar(0): zero non-sort-key properties
     packed = getattr(graph.backend.manager.features, "packed_ops", False)
-    starts = col_offs[:-1]
-    lens = np.diff(col_offs)
     P = len(edge_prefix)
-    K = int(lens.max() - P) if m else 0
+    if packed:
+        starts = col_offs[:-1]
+        lens = np.diff(col_offs)
+        K = int(lens.max() - P) if m else 0
     if packed and K <= 16:
         # packed bulk path: rows are adopted whole, so columns must
         # arrive byte-sorted. All edge columns share the category
