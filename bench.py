@@ -58,10 +58,14 @@ _EST = {
     "bfs26": 600,        # 9GB upload + compiles + 3 reps x ~12s
     "ssspwcc": 300,      # frontier SSSP + BFS-seeded WCC
     "pagerank": 120,     # 0.6GB upload + compile + 12 iterations
-    "store_ingest": 400,  # packed bulk ingest s22 + native packed scan
-                          # + CSR + BFS (measured s20: 54s end-to-end;
-                          # s22 projects ~310s + compile headroom)
-    "bfs_heavy": 450,    # ~10GB upload + 2 reps (graph pre-built on disk)
+    "store_ingest": 550,  # packed bulk ingest s22 + native packed scan
+                          # + CSR + BFS (measured in-bench: 578s with
+                          # the s26 graph resident in host RAM; the
+                          # stage is the north-star store->CSR proof
+                          # and outranks the stages after it)
+    "bfs_heavy": 300,    # 11.6GB upload (fast-day) + 2 reps; measured
+                         # 9.97s = 148.1M TEPS when it fits (numbers in
+                         # PERF_NOTES r5 / STATUS)
 }
 
 
@@ -294,7 +298,13 @@ def _bfs_stage(rep: Report, scale: int, tag: str) -> None:
     }
     if tag == "headline":
         # only the headline scale owns the report's metric line — the
-        # warm-scale stage runs AFTER it and must not overwrite it
+        # warm-scale stage runs AFTER it and must not overwrite it.
+        # vs_baseline stays the RAW ratio against the 1B v5e-8 target;
+        # the per-chip share (target/8 — only one chip exists in this
+        # environment) is recorded alongside for honest comparison
+        if r["n_devices"] == 1:
+            rep.detail[f"bfs_s{scale}"]["per_chip_share_of_1e9_target"] = \
+                round(r["teps"] / (1e9 / 8), 3)
         rep.headline(f"graph500_scale{scale}_bfs_teps",
                      round(r["teps"], 1), "TEPS",
                      round(r["teps"] / 1e9, 4))
@@ -683,9 +693,13 @@ def main() -> None:
                   if s[0] not in ("bfs23", "bfs23_sharded")]
 
     for name, fn in stages:
-        if _left() < _EST.get(name, 60):
-            rep.skip(name, f"budget: {_left():.0f}s left < "
-                           f"est {_EST.get(name, 60)}s")
+        est = _EST.get(name, 60)
+        if headline_scale < 20:
+            # CI/smoke scales: the table's estimates assume bench-scale
+            # graphs; a scale-12 CPU run costs ~1/10th
+            est = max(est // 10, 20)
+        if _left() < est:
+            rep.skip(name, f"budget: {_left():.0f}s left < est {est}s")
             continue
         try:
             fn()
