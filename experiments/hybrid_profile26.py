@@ -1,10 +1,11 @@
 """Per-dispatch timing of the hybrid BFS at bench scale (default 26).
 
-Replicates frontier_bfs_hybrid's driver loop with a wall timer around
-every dispatch; the stats readback after each td/bu call IS the sync
-(block_until_ready is dispatch-only through the axon tunnel). Usage:
+Wraps every jitted kernel in the process cache with a sync-forcing
+timer, so each dispatch's wall cost is attributed by kernel name and
+cap bucket (block_until_ready is dispatch-only through the axon tunnel;
+the forced 1-element readback is the real sync). Usage:
 
-    python experiments/hybrid_profile26.py [scale] [source_rank]
+    python experiments/hybrid_profile26.py [scale]
 """
 import os
 import sys
@@ -18,147 +19,68 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def main():
     import jax
-    import jax.numpy as jnp
 
     import titan_tpu.models.bfs_hybrid as H
+    import titan_tpu.utils.jitcache as jc
     from titan_tpu.olap.tpu import graph500
+    from titan_tpu.utils.jitcache import enable_compile_cache
 
-    cache = __file__.rsplit("/", 2)[0] + "/.bench_cache/xla"
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-    except Exception:
-        pass
-
+    enable_compile_cache()
     scale = int(sys.argv[1]) if len(sys.argv) > 1 else 26
     t0 = time.time()
     hg = graph500.load_or_build(scale, 16, seed=2, verbose=False)
-    print(f"load: {time.time()-t0:.1f}s")
-    t0 = time.time()
     g = graph500.to_device(hg)
     jax.block_until_ready(g["dstT"])
     _ = np.asarray(g["colstart"][0])     # force real completion
-    print(f"upload: {time.time()-t0:.1f}s")
+    print(f"load+upload: {time.time()-t0:.1f}s")
 
     deg = np.asarray(hg["deg"])
     rng = np.random.default_rng(12345)
-    nonzero = np.flatnonzero(deg > 0)
-    source = int(rng.choice(nonzero, size=1)[0])
+    source = int(rng.choice(np.flatnonzero(deg > 0), size=1,
+                            replace=False)[0])
 
-    n = g["n"]
-    dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
-    td = H._td_step(); bu = H._bu_rounds(); ex = H._bu_exhaust()
-    buwrap = H._bu_wrap(); frontier_of = H._frontier_of()
-    all_unvis = H._all_unvisited()
-    total_chunks = int(g["q_total"] - 1)
-    cap_n = H._next_pow2(max(n, 2))
-    INF = H.INF
-
-    def pad(a):
-        if a.shape[0] < cap_n:
-            a = jnp.concatenate(
-                [a, jnp.full((cap_n - a.shape[0],), n, a.dtype)])
-        return a
-
-    # warm-up/compile pass (cached executables load from .bench_cache/xla)
     t0 = time.time()
     d, lv = H.frontier_bfs_hybrid(g, source, return_device=True)
     _ = np.asarray(d[0])
-    print(f"warm run (incl. compiles): {time.time()-t0:.1f}s lv={lv}")
+    print(f"warm-up run (incl. compiles): {time.time()-t0:.1f}s lv={lv}")
+    del d
 
     for rep in range(2):
-        t_all = time.time()
-        dist = jnp.full((n + 1,), INF, jnp.int32).at[source].set(0)
-        frontier = pad(jnp.full((1,), source, jnp.int32))
-        f_count = 1
-        m8_f = int(np.asarray(degc[source]))
-        m8_unvis = total_chunks - m8_f
-        mode = "td"; cand = None; c_count = 0; level = 0
-        while f_count > 0 and level < 100:
+        t0 = time.time()
+        d, lv = H.frontier_bfs_hybrid(g, source, return_device=True)
+        _ = np.asarray(d[0])
+        print(f"clean warm run {rep}: {time.time()-t0:.2f}s lv={lv}")
+        del d
+
+    times = []
+    orig = {}
+
+    def wrap(name, fn):
+        def run(*a, **k):
             t0 = time.time()
-            use_bu = m8_f * H.ALPHA > m8_unvis and f_count > 1
-            if use_bu and mode == "td":
-                cand, c_count = all_unvis(dist, degc, n_=n)
-                c_count = int(c_count)
-                cand = pad(cand)
-                mode = "bu"
-                print(f"  lv{level} all_unvis: {time.time()-t0:.3f}s "
-                      f"(dispatch; syncs with next stats read)")
-            elif not use_bu:
-                mode = "td"
-            if mode == "td":
-                if m8_f == 0:
-                    break
-                t0 = time.time()
-                if frontier is None:
-                    frontier = pad(frontier_of(dist, jnp.int32(level), n_=n))
-                f_cap = min(H._next_pow2(max(f_count, 2)), cap_n)
-                p_cap = min(H._next_pow2(max(m8_f, 2)),
-                            H._next_pow2(max(total_chunks + n, 2)))
-                dist, frontier, st = td(
-                    dist, frontier[:f_cap], jnp.int32(f_count),
-                    jnp.int32(level), dstT, colstart, degc,
-                    f_cap=f_cap, p_cap=p_cap, n_=n)
-                frontier = pad(frontier)
-                f_count, m8_f, m8_unvis, _ = (int(x) for x in np.asarray(st))
-                print(f"  lv{level} TD f_cap={f_cap} p_cap={p_cap}: "
-                      f"{time.time()-t0:.3f}s -> nf={f_count} m8_f={m8_f}")
-            else:
-                active = cand
-                a_count = c_count
-                src_cap = min(H._next_pow2(max(c_count, 2)), cap_n)
-                off = jnp.zeros(active.shape, jnp.int32)
-                rounds = 0
-                rem_total = total_chunks
-                wrap_stats = None
-                while a_count > 0 and rounds < H.BU_CHUNK_ROUNDS:
-                    c_cap = min(H._next_pow2(max(a_count, 2)), cap_n)
-                    fuse = 1 if rounds == 0 else H.BU_CHUNK_ROUNDS - rounds
-                    t0 = time.time()
-                    dist, active, off, cand_next, st = bu(
-                        dist, active[:c_cap], off[:c_cap],
-                        jnp.int32(a_count), cand[:src_cap],
-                        jnp.int32(c_count), jnp.int32(level),
-                        dstT, colstart, degc, c_cap=c_cap,
-                        src_cap=src_cap, n_=n, fuse=fuse)
-                    sth = [int(x) for x in np.asarray(st)]
-                    a_count, rem_total = sth[0], sth[1]
-                    print(f"  lv{level} BU c_cap={c_cap} fuse={fuse}: "
-                          f"{time.time()-t0:.3f}s -> alive={a_count} "
-                          f"rem8={rem_total}")
-                    if a_count == 0:
-                        wrap_stats = (cand_next, sth[2], sth[3], sth[4],
-                                      sth[5])
-                    rounds += fuse
-                if a_count > 0:
-                    c_cap = min(H._next_pow2(max(a_count, 2)), cap_n)
-                    rem_cap = H._next_pow2(max(rem_total, 2))
-                    t0 = time.time()
-                    dist = ex(dist, active[:c_cap], off[:c_cap],
-                              jnp.int32(a_count), jnp.int32(level), dstT,
-                              colstart, degc, c_cap=c_cap, p_cap=rem_cap,
-                              n_=n)
-                    _ = np.asarray(dist[0])
-                    print(f"  lv{level} EX c_cap={c_cap} p_cap={rem_cap}: "
-                          f"{time.time()-t0:.3f}s")
-                    wrap_stats = None
-                if wrap_stats is not None:
-                    cand, c_count, f_count, m8_f, m8_unvis = wrap_stats
-                    cand = pad(cand)
-                else:
-                    t0 = time.time()
-                    cand, st = buwrap(dist, cand[:src_cap],
-                                      jnp.int32(c_count), jnp.int32(level),
-                                      degc, n_=n, src_cap=src_cap)
-                    cand = pad(cand)
-                    c_count, f_count, m8_f, m8_unvis = \
-                        (int(x) for x in np.asarray(st))
-                    print(f"  lv{level} BUwrap: {time.time()-t0:.3f}s "
-                          f"-> nf={f_count}")
-                frontier = None
-            level += 1
-        print(f"rep{rep} TOTAL {time.time()-t_all:.3f}s levels={level}")
+            out = fn(*a, **k)
+            x = out[0] if isinstance(out, tuple) else out
+            try:
+                _ = np.asarray(x.ravel()[0])
+            except Exception:
+                jax.block_until_ready(x)
+            times.append((name, k.get("c_cap"), k.get("f_cap"),
+                          k.get("p_cap"), time.time() - t0))
+            return out
+        return run
+
+    for name in list(jc._JITS):
+        orig[name] = jc._JITS[name]
+        jc._JITS[name] = wrap(name, jc._JITS[name])
+    d, lv = H.frontier_bfs_hybrid(g, source, return_device=True)
+    _ = np.asarray(d[0])
+    for name, cc, fc, pc, dt in times:
+        print(f"  {name} c={cc} f={fc} p={pc} {dt:.3f}s")
+    for name, fn in orig.items():
+        jc._JITS[name] = fn
+    print("note: per-kernel syncs serialize the pipeline — the clean "
+          "warm runs above are the true wall; this breakdown attributes "
+          "it (approximately) by dispatch")
 
 
 main()
