@@ -390,11 +390,15 @@ def _bu_start():
 def flagged_colstart(g, lanes: int):
     """Per-graph cache: ``colstart | (deg <= lanes) << 31`` — the opener
     needs both ``colstart[v]`` and the "could later lanes still hit?"
-    predicate, and two separate 33M-candidate gathers into 268MB tables
-    measured ~1.9s at scale 26; packing the predicate into colstart's
-    free sign bit halves that (colstart < 2^31 by the chunked-CSR int32
-    contract). Built once per graph per lane width (one n-scale
-    elementwise pass) and cached in the graph dict."""
+    predicate per candidate; packing the predicate into colstart's free
+    sign bit (colstart < 2^31 by the chunked-CSR int32 contract) lets
+    ONE array carry both through the opener's shared-index scatter
+    compaction (historically: two separate 33M-candidate gathers into
+    268MB tables measured ~1.9s at scale 26; the packed array first
+    halved that, and the scatter formulation in _bu_startL now avoids
+    the per-candidate gather entirely — this array is read
+    CONTIGUOUSLY there). Built once per graph per lane width (one
+    n-scale elementwise pass) and cached in the graph dict."""
     import jax.numpy as jnp
 
     key = f"_csflag{lanes}"
@@ -428,24 +432,38 @@ def _bu_startL():
             ``lanes``-wide chunk-0 bitmap test (the leading-lane slice
             ``dstT[:lanes]`` fuses into the gather — no copy, see
             experiments/lane_split_probe.py). ``csflag`` is
-            flagged_colstart(g, lanes): one gather yields the column AND
-            the deg <= lanes predicate. Candidates that miss the tested
-            lanes AND have deg > lanes are compacted as UNTESTED (their
-            remaining lanes may still hit — _bu_finish_chunk0 decides
-            them at a host-sized cap); deg <= lanes misses are decided
-            (pad lanes never hit). Level-end stats under lax.cond when
-            no untested remain (then no bu_more survivors can exist
-            either, since degc > 1 implies deg > 8)."""
+            flagged_colstart(g, lanes): column and deg <= lanes
+            predicate in one int32, read CONTIGUOUSLY and compacted
+            alongside the candidate list by the shared-index double
+            scatter below — no per-candidate table gather at all.
+            Candidates that miss the tested lanes AND have deg > lanes
+            are compacted as UNTESTED (their remaining lanes may still
+            hit — _bu_finish_chunk0 decides them at a host-sized cap);
+            deg <= lanes misses are decided (pad lanes never hit).
+            Level-end stats under lax.cond when no untested remain
+            (then no bu_more survivors can exist either, since
+            degc > 1 implies deg > 8)."""
             q_pad = dstT.shape[1] - 1
             fbits = _pack_bits(dist, level, n_)
             unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
-            cand = jnp.nonzero(unvis, size=c_cap,
-                               fill_value=n_)[0].astype(jnp.int32)
-            c_count = unvis.sum().astype(jnp.int32)
+            # candidate build as a shared-index DOUBLE scatter: the
+            # list compaction and the per-candidate csflag fetch land
+            # in one fused pass (XLA fuses scatters with identical
+            # indices), replacing nonzero + a 268MB-table gather —
+            # measured 1.76s -> 1.07s at the scale-26 heavy level.
+            # csflag is read CONTIGUOUSLY here (elementwise), which is
+            # what makes the gather-free formulation possible.
+            cs = jnp.cumsum(unvis.astype(jnp.int32))
+            tgt = jnp.where(unvis, cs - 1, c_cap)
+            ids = jnp.arange(n_, dtype=jnp.int32)
+            cand = jnp.full((c_cap,), n_, jnp.int32).at[tgt].set(
+                ids, mode="drop")
+            csf = jnp.zeros((c_cap,), jnp.int32).at[tgt].set(
+                csflag[:n_], mode="drop")
+            c_count = cs[n_ - 1]
 
             alive = jnp.arange(c_cap) < c_count
             v = jnp.minimum(cand, n_)
-            csf = csflag[v]
             small = csf < 0                      # deg <= lanes
             cols = jnp.where(alive, csf & 0x7FFFFFFF, q_pad)
             parentsL = jnp.take(dstT[:lanes], jnp.clip(cols, 0, q_pad),
