@@ -381,6 +381,13 @@ def sssp_wcc(rep: Report, scale: int) -> None:
     # NO warm-up pass: at bench scale one SSSP run costs ~400s (measured
     # 2026-07-30: 25 sliced rounds) — executables come from the
     # persistent XLA cache, so a single timed run is representative
+    trace: list = []
+    g["_trace_rounds"] = trace       # per-round (band, nf, m8, t, plan_s)
+    # isolation drains make plan_s exact at ONE extra host round trip
+    # per round — sssp_seconds therefore includes ~rounds x RT of
+    # measurement overhead; the count is disclosed below so the <100s
+    # comparison can bound it (r5's 121-130s band was untraced)
+    g["_trace_plan_drain"] = True
     t0 = time.time()
     d, rounds = frontier_sssp(g, source, return_device=True)
     jax.block_until_ready(d)
@@ -388,6 +395,24 @@ def sssp_wcc(rep: Report, scale: int) -> None:
     rep.detail["sssp_seconds"] = round(time.time() - t0, 3)
     rep.detail["sssp_rounds"] = rounds
     rep.detail["sssp_scale"] = scale
+    # per-round PLAN cost (the band extraction + segment-bounds kernel +
+    # its one host sync, isolated by a pre-plan drain in _frontier_run):
+    # the r5 floor was ~1.1s/round of n-wide nonzero + cap-wide gather;
+    # the compaction-library plan must hold this ≥2x lower (ISSUE r6) —
+    # recorded here so every bench run keeps the evidence
+    plan_costs = [r[4] for r in trace if len(r) > 4]
+    if plan_costs:
+        rep.detail["sssp_plan_s_per_round_mean"] = round(
+            float(np.mean(plan_costs)), 4)
+        rep.detail["sssp_plan_s_per_round_p50"] = round(
+            float(np.median(plan_costs)), 4)
+        rep.detail["sssp_plan_s_per_round_max"] = round(
+            float(np.max(plan_costs)), 4)
+        rep.detail["sssp_plan_s_total"] = round(
+            float(np.sum(plan_costs)), 3)
+        rep.detail["sssp_plan_isolation_drains"] = len(plan_costs)
+    del g["_trace_rounds"]           # WCC below must not pay the drains
+    del g["_trace_plan_drain"]
     rep.emit()
 
     t0 = time.time()

@@ -34,8 +34,12 @@ def main(scale=23, masses=(0, 1 << 22, 1 << 24, 1 << 25)):
         dt = time.time() - t0
         tr = g.pop("_trace_rounds")
         mass = sum(t[2] for t in tr)
+        plan_costs = [t[4] for t in tr if len(t) > 4]
+        plan_mean = (sum(plan_costs) / len(plan_costs)) \
+            if plan_costs else 0.0
         print(f"qm={qm}: {dt:.1f}s rounds={rounds} "
-              f"total_mass={mass / 1e6:.0f}M chunks", flush=True)
+              f"total_mass={mass / 1e6:.0f}M chunks "
+              f"plan_mean={plan_mean:.3f}s", flush=True)
         if base is None:
             base = d
         else:

@@ -180,3 +180,26 @@ def test_bulk_load_fallback_without_packed_ops(tmp_path):
         assert snap.n == 32 and snap.num_edges == 64
     finally:
         g.close()
+
+
+def test_packed_path_refuses_shared_category_prefix_byte(monkeypatch):
+    """The packed bulk path orders the exists column against the edge
+    columns by ONE byte-compare — sound only while category prefixes
+    differ in their first byte. A codec drift that shares the byte must
+    be refused up front (ADVICE r5 #4), never adopted as unsorted rows."""
+    import pytest
+
+    real = bulk.rids.type_prefix
+
+    def shared_first_byte(type_id, idm, category, direction):
+        return b"\x7f" + real(type_id, idm, category, direction)[1:]
+
+    monkeypatch.setattr(bulk.rids, "type_prefix", shared_first_byte)
+    g = titan_tpu.open("inmemory")
+    try:
+        assert g.backend.manager.features.packed_ops
+        src, dst = _ring_edges(8)
+        with pytest.raises(AssertionError, match="share their first byte"):
+            bulk.bulk_load_adjacency(g, src, dst, n=8)
+    finally:
+        g.close()

@@ -412,13 +412,17 @@ def test_fused_bfs_overflow_falls_back(monkeypatch):
 def test_sssp_quantile_list_truncation_is_sound(monkeypatch):
     """A fixed in-band list cap smaller than the band must only defer
     vertices (they stay improved and get re-planned), never drop or
-    corrupt distances — the soundness contract of _quant_plan's
-    truncating nonzero."""
+    corrupt distances — the soundness contract of _band_plan's
+    truncating compaction (ops.compaction.banded_frontier)."""
     monkeypatch.setattr(F, "QUANT_LIST_CAP", 8)
     rng = np.random.default_rng(21)
     n = 150
     snap = sym_snap(rng, n, 600)
     source = int(np.flatnonzero(snap.out_degree > 0)[0])
+    # plain mode ignores QUANT_LIST_CAP (it lists at full w_max width so
+    # dense rounds keep the r5 one-round coverage) but still truncates
+    # at w_max=128 < n=150 here — both truncation regimes must only
+    # defer, never corrupt
     ref, _ = F.frontier_sssp(snap, source, quantile_mass=0)
     got, rounds = F.frontier_sssp(snap, source, quantile_mass=64)
     assert np.asarray(got) == pytest.approx(np.asarray(ref), rel=1e-6)

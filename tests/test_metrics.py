@@ -273,3 +273,45 @@ def test_graph_wires_reporters_from_config(tmp_path):
     assert _os.path.exists(d + "/metrics.csv")
     # close() stopped the thread
     assert not g._reporters[0]._thread.is_alive()
+
+
+def test_start_reporters_dedups_per_manager_and_sink():
+    """Two graphs with the same reporter config must SHARE one reporter
+    thread (no duplicate console/CSV/Graphite streams — ADVICE r5 #5),
+    and the shared reporter is refcounted: closing one graph must not
+    silence the other."""
+    from titan_tpu.config import defaults as d
+    from titan_tpu.utils.metrics import MetricManager, start_reporters
+
+    class _Cfg:
+        def get(self, opt, *a):
+            if opt is d.METRICS_CONSOLE_INTERVAL:
+                return 300.0      # never fires during the test
+            if opt is d.METRICS_PREFIX:
+                return "tt"
+            return 0
+
+    m = MetricManager()
+    cfg = _Cfg()
+    r1 = start_reporters(cfg, m)
+    r2 = start_reporters(cfg, m)
+    m2 = MetricManager()
+    r3 = start_reporters(cfg, m2)
+    try:
+        assert len(r1) == len(r2) == 1
+        assert r1[0] is r2[0], "same (manager, sink) must share"
+        assert r3[0] is not r1[0], "a different manager gets its own"
+        r1[0].stop()
+        assert not r1[0].stopped, "first close must not kill the shared one"
+        r2[0].stop()
+        assert r2[0].stopped, "last close ends the thread"
+        # final stop evicts the registry entry (no dead-reporter pinning)
+        from titan_tpu.utils.metrics import _ACTIVE_REPORTERS
+        assert r1[0] not in _ACTIVE_REPORTERS.values()
+        # a fresh start after full shutdown spawns a NEW reporter
+        r4 = start_reporters(cfg, m)
+        assert r4[0] is not r1[0] and not r4[0].stopped
+        r4[0].stop()
+        assert r4[0] not in _ACTIVE_REPORTERS.values()
+    finally:
+        r3[0].stop()

@@ -123,7 +123,11 @@ class StandardGraph:
             # gated on its interval option; stopped at close(). Only
             # started when collection is on — a reporter without
             # metrics.enabled would dump empty (or another graph's)
-            # snapshots from the shared registry forever
+            # snapshots from the shared registry forever. Startup is
+            # deduped per (manager, sink): two graphs with the same
+            # reporter config share one refcounted reporter thread, so
+            # neither emits a duplicate stream and closing one graph
+            # doesn't silence the other
             self._reporters = start_reporters(config, self._metrics)
 
     # -- mixed index providers ----------------------------------------------

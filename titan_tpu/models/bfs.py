@@ -203,11 +203,12 @@ def _sharded_level_step():
             # ICI all-reduce: every chip gets the global minimum distances
             return jax.lax.pmin(new_dist, VERTEX_AXIS)
 
-        dist = jax.shard_map(
+        from titan_tpu.parallel.mesh import shard_map_compat
+        dist = shard_map_compat(
             per_shard, mesh=mesh,
             in_specs=(P(), P(), P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                       P(VERTEX_AXIS, None)),
-            out_specs=P(), check_vma=False,
+            out_specs=P(),
         )(dist, frontier, dst_sh, ip_sh, deg_sh)
 
         # device-side compaction: the host reads back ONE small stats array

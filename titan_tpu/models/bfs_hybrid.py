@@ -49,6 +49,8 @@ import functools
 import numpy as np
 
 from titan_tpu.models.bfs import INF, _next_pow2
+from titan_tpu.ops.compaction import (claim_dedup, claim_reset,
+                                      compact_ids, scatter_compact)
 
 # mode-switch thresholds (Beamer-style, tuned on v5e):
 # td->bu when the frontier's (chunked) edge mass exceeds 1/ALPHA of the
@@ -227,13 +229,14 @@ def _head_loop():
             stays the right mode; ONE dispatch, one stats readback.
 
             NO n-scale work per iteration: the next frontier is deduped
-            from the scatter targets with a CLAIM array (first lane to
-            claim a newly-found vertex wins; every op is p_cap-scale —
-            the old per-iteration n-wide nonzero + n-wide stats cost
-            ~1.1s of the 1.41s head at scale 26), and the
-            unvisited-mass stats are maintained as running differences.
-            The claim array is reset by re-scattering sentinels at the
-            SAME p_cap positions, so it stays clean without an n-pass."""
+            from the scatter targets with a CLAIM array
+            (ops.compaction.claim_dedup — first lane to claim a
+            newly-found vertex wins; every op is p_cap-scale — the old
+            per-iteration n-wide nonzero + n-wide stats cost ~1.1s of
+            the 1.41s head at scale 26), and the unvisited-mass stats
+            are maintained as running differences. claim_reset
+            re-scatters sentinels at the SAME p_cap positions, so the
+            claim array stays clean without an n-pass."""
             q_pad = dstT.shape[1] - 1
             lanes = 8 * p_cap
 
@@ -257,21 +260,16 @@ def _head_loop():
                 dist = dist.at[nbr].min(level + 1, mode="drop")
                 lane_id = jnp.arange(lanes, dtype=jnp.int32) \
                     .reshape(8, p_cap)
-                claim = claim.at[newly].min(lane_id, mode="drop")
-                winner = (claim[newly] == lane_id) & (newly <= n_)
+                claim, won = claim_dedup(claim, newly, lane_id)
+                winner = won & (newly <= n_)
                 nf = winner.sum().astype(jnp.int32)
                 degn = degc[jnp.minimum(newly, n_)]
                 m8_next = jnp.where(winner, degn, 0).sum(dtype=jnp.int32)
-                # compact the winners: p-scale nonzero over the lanes
-                flat_new = jnp.where(winner, newly, n_ + 1).ravel()
-                idx = jnp.nonzero(flat_new <= n_, size=f_cap,
-                                  fill_value=lanes - 1)[0]
-                keep = jnp.arange(f_cap) < nf
-                nxt = jnp.where(keep, flat_new[idx], n_) \
-                    .astype(jnp.int32)
+                # compact the winners: p-scale scatter compaction
+                _, (nxt,) = scatter_compact(
+                    winner.ravel(), (newly.ravel(),), f_cap, (n_,))
                 # reset the claim entries this level touched
-                claim = claim.at[newly].set(jnp.int32(2**31 - 1),
-                                            mode="drop")
+                claim = claim_reset(claim, newly)
                 m8_unvis2 = m8_unvis - m8_next
                 n_unvis2 = n_unvis - jnp.where(winner & (degn > 0),
                                                1, 0).sum(dtype=jnp.int32)
@@ -350,9 +348,7 @@ def _bu_start():
             q_pad = dstT.shape[1] - 1
             fbits = _pack_bits(dist, level, n_)
             unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
-            cand = jnp.nonzero(unvis, size=c_cap,
-                               fill_value=n_)[0].astype(jnp.int32)
-            c_count = unvis.sum().astype(jnp.int32)
+            c_count, cand = compact_ids(unvis, c_cap, n_)
 
             alive = jnp.arange(c_cap) < c_count
             v = jnp.minimum(cand, n_)
@@ -366,13 +362,11 @@ def _bu_start():
             nc = surv.sum().astype(jnp.int32)
 
             def compact(_):
-                idx = jnp.nonzero(surv, size=c_cap,
-                                  fill_value=c_cap - 1)[0]
-                keep = jnp.arange(c_cap) < nc
-                cand2 = jnp.where(keep, cand[idx], n_)
+                _, (cand2,) = scatter_compact(surv, (cand,), c_cap,
+                                              (n_,))
                 rem8 = jnp.where(surv, degc[v] - 1, 0) \
                     .sum(dtype=jnp.int32)
-                return cand2.astype(jnp.int32), rem8
+                return cand2, rem8
 
             def no_compact(_):
                 return jnp.full((c_cap,), n_, jnp.int32), jnp.int32(0)
@@ -446,21 +440,17 @@ def _bu_startL():
             q_pad = dstT.shape[1] - 1
             fbits = _pack_bits(dist, level, n_)
             unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
-            # candidate build as a shared-index DOUBLE scatter: the
-            # list compaction and the per-candidate csflag fetch land
-            # in one fused pass (XLA fuses scatters with identical
-            # indices), replacing nonzero + a 268MB-table gather —
-            # measured 1.76s -> 1.07s at the scale-26 heavy level.
-            # csflag is read CONTIGUOUSLY here (elementwise), which is
-            # what makes the gather-free formulation possible.
-            cs = jnp.cumsum(unvis.astype(jnp.int32))
-            tgt = jnp.where(unvis, cs - 1, c_cap)
-            ids = jnp.arange(n_, dtype=jnp.int32)
-            cand = jnp.full((c_cap,), n_, jnp.int32).at[tgt].set(
-                ids, mode="drop")
-            csf = jnp.zeros((c_cap,), jnp.int32).at[tgt].set(
-                csflag[:n_], mode="drop")
-            c_count = cs[n_ - 1]
+            # candidate build as a shared-index DOUBLE scatter
+            # (ops.compaction.scatter_compact): the list compaction and
+            # the per-candidate csflag fetch land in one fused pass
+            # (XLA fuses scatters with identical indices), replacing
+            # nonzero + a 268MB-table gather — measured 1.76s -> 1.07s
+            # at the scale-26 heavy level. csflag is read CONTIGUOUSLY
+            # (elementwise), which is what makes the gather-free
+            # formulation possible.
+            c_count, (cand, csf) = scatter_compact(
+                unvis, (jnp.arange(n_, dtype=jnp.int32), csflag[:n_]),
+                c_cap, (n_, 0))
 
             alive = jnp.arange(c_cap) < c_count
             v = jnp.minimum(cand, n_)
@@ -476,10 +466,8 @@ def _bu_startL():
             nu = untested.sum().astype(jnp.int32)
 
             def compact(_):
-                idx = jnp.nonzero(untested, size=c_cap,
-                                  fill_value=c_cap - 1)[0]
-                keep = jnp.arange(c_cap) < nu
-                return jnp.where(keep, cand[idx], n_).astype(jnp.int32)
+                return scatter_compact(untested, (cand,), c_cap,
+                                       (n_,))[1][0]
 
             def no_compact(_):
                 return jnp.full((c_cap,), n_, jnp.int32)
@@ -528,13 +516,11 @@ def _bu_finish_chunk0():
             nc = surv.sum().astype(jnp.int32)
 
             def compact(_):
-                idx = jnp.nonzero(surv, size=c_cap,
-                                  fill_value=c_cap - 1)[0]
-                keep = jnp.arange(c_cap) < nc
-                cand2 = jnp.where(keep, cand[idx], n_)
+                _, (cand2,) = scatter_compact(surv, (cand,), c_cap,
+                                              (n_,))
                 rem8 = jnp.where(surv, degc[v] - 1, 0) \
                     .sum(dtype=jnp.int32)
-                return cand2.astype(jnp.int32), rem8
+                return cand2, rem8
 
             def no_compact(_):
                 return jnp.full((c_cap,), n_, jnp.int32), jnp.int32(0)
@@ -577,12 +563,11 @@ def _bu_more():
                 dist = dist.at[jnp.where(found, v, n_ + 1)].set(
                     level + 1, mode="drop")
                 surv = alive & ~found & (off + 1 < degc[v])
-                idx = jnp.nonzero(surv, size=c_cap,
-                                  fill_value=c_cap - 1)[0]
                 nc = surv.sum().astype(jnp.int32)
-                keep = jnp.arange(c_cap) < nc
-                cand = jnp.where(keep, cand[idx], n_)
-                off = jnp.where(keep, off[idx] + 1, 0)
+                # survivor list + its chunk cursor compacted through
+                # ONE shared index (scatter_compact fuses the pair)
+                _, (cand, off) = scatter_compact(
+                    surv, (cand, off + 1), c_cap, (n_, 0))
                 return (dist, cand, off, nc), None
 
             (dist, cand, off, c_count), _ = jax.lax.scan(
@@ -651,7 +636,8 @@ def _endgame():
             unvisited set — candidate count and chunk mass are bounded by
             the entry caps, so shapes are static and the loop needs no
             host round trips. The candidate list is built ONCE (one
-            n-scale nonzero) and compacted at c_cap width between
+            n-scale scatter compaction) and re-compacted at c_cap
+            width between
             iterations. Terminates when a level finds nothing.
             Caller guarantee: n_unvis <= c_cap and m8_unvis <= p_cap."""
             q_pad = dstT.shape[1] - 1
@@ -680,18 +666,13 @@ def _endgame():
                 nfound = found.sum().astype(jnp.int32)
                 # compact survivors at c_cap width (no n-scale pass)
                 surv = valid & ~found
-                idx = jnp.nonzero(surv, size=c_cap,
-                                  fill_value=c_cap - 1)[0]
                 nc = surv.sum().astype(jnp.int32)
-                keep = jnp.arange(c_cap) < nc
-                cand = jnp.where(keep, v[idx], n_).astype(jnp.int32)
+                _, (cand,) = scatter_compact(surv, (v,), c_cap, (n_,))
                 return (dist, cand, nc, level + 1, nfound,
                         iters + (nfound > 0).astype(jnp.int32))
 
             unvis = (dist[:n_] >= INF) & (degc[:n_] > 0)
-            cand0 = jnp.nonzero(unvis, size=c_cap,
-                                fill_value=n_)[0].astype(jnp.int32)
-            c0 = unvis.sum().astype(jnp.int32)
+            c0, cand0 = compact_ids(unvis, c_cap, n_)
             state = (dist, cand0, c0, level0, jnp.int32(1), jnp.int32(0))
             dist, _, _, _, _, iters = jax.lax.while_loop(cond, body,
                                                          state)
@@ -707,9 +688,10 @@ def _frontier_of():
 
         @functools.partial(jax.jit, static_argnames=("n_",))
         def fr(dist, level, n_: int):
+            # scatter compaction, not nonzero: the n-wide nonzero here
+            # measured ~0.9s at scale 26 (see ops/compaction.py)
             changed = dist[:n_] == level
-            return jnp.nonzero(
-                changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
+            return compact_ids(changed, n_, n_)[1]
         return fr
     return _get("hybrid_frontier_of", build)
 

@@ -56,10 +56,18 @@ import numpy as np
 from titan_tpu.models.bfs import INF, _next_pow2
 from titan_tpu.models.bfs_hybrid import (_bit_of, _pack_bits,
                                          enumerate_chunk_pairs)
+from titan_tpu.ops.compaction import compact_ids, scatter_compact
 from titan_tpu.utils.jitcache import jit_once
 
 ALPHA = 8.0
 BU_CHUNK_ROUNDS = 8
+
+
+def _shard_map(f, **kw):
+    # version-spanning shard_map (deferred import keeps module import
+    # jax-free, matching the rest of this file)
+    from titan_tpu.parallel.mesh import shard_map_compat
+    return shard_map_compat(f, **kw)
 
 # stats vector layout (the exchange's replicated output; the first four
 # entries predate the per-chip cap stats)
@@ -233,13 +241,12 @@ def _td_expand():
                 nbr = jnp.take(dstT_l, cols, axis=1)
                 return dist.at[nbr].min(level + 1, mode="drop")[None]
 
-            return jax.shard_map(
+            return _shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(P(), P(), P(VERTEX_AXIS, None, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS), P(VERTEX_AXIS)),
                 out_specs=P(VERTEX_AXIS, None),
-                check_vma=False,
             )(dist, frontier, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
         return td
     return jit_once("shbfs_td", build)
@@ -273,8 +280,10 @@ def _exchange():
                 newly = dist[0][:n_] == level + 1
                 cnt = newly.sum().astype(jnp.int32)
                 found_max = jax.lax.pmax(cnt, VERTEX_AXIS)
-                ids = jnp.nonzero(newly, size=found_cap,
-                                  fill_value=n_ + 1)[0].astype(jnp.int32)
+                # exchange list build via the shared scan/scatter
+                # compaction (ops.compaction) — same n-wide-nonzero
+                # elimination as the single-chip round loops
+                _, ids = compact_ids(newly, found_cap, n_ + 1)
                 all_ids = jax.lax.all_gather(ids, VERTEX_AXIS)  # [D, cap]
                 merged = dist[0].at[all_ids.ravel()].min(
                     level + 1, mode="drop")
@@ -300,11 +309,11 @@ def _exchange():
                 return merged, jnp.stack(
                     [nf, m8_f, m8_unvis, found_max, m8f_chip, nunv_chip])
 
-            return jax.shard_map(
+            return _shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(P(VERTEX_AXIS, None), P(), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS), P(VERTEX_AXIS)),
-                out_specs=(P(), P()), check_vma=False,
+                out_specs=(P(), P()),
             )(dist_sh, degc, degc_sh, lo_sh, hi_sh)
         return ex
     return jit_once("shbfs_exchange", build)
@@ -322,8 +331,7 @@ def _frontier_of_sh():
             frontier list, and the n-scale nonzero was the exchange's
             single biggest per-level cost on bu-heavy runs)."""
             changed = dist[:n_] == level
-            return jnp.nonzero(
-                changed, size=n_, fill_value=n_)[0].astype(jnp.int32)
+            return compact_ids(changed, n_, n_)[1]
         return fr
     return jit_once("shbfs_frontier_of", build)
 
@@ -375,9 +383,7 @@ def _bu_start_sh():
                 cand_mask = (block < hi - lo) \
                     & (dist[jnp.minimum(block + lo, n_)] >= INF) \
                     & (degc_l > 0)
-                cand = jnp.nonzero(cand_mask, size=c_cap,
-                                   fill_value=b_max)[0].astype(jnp.int32)
-                c_count = cand_mask.sum().astype(jnp.int32)
+                c_count, cand = compact_ids(cand_mask, c_cap, b_max)
                 alive = jnp.arange(c_cap) < c_count
                 lv = jnp.clip(cand, 0, b_max - 1)
                 cols = jnp.where(alive, cs_l[lv], q_pad)
@@ -391,12 +397,11 @@ def _bu_start_sh():
                 nc = surv.sum().astype(jnp.int32)
 
                 def compact(_):
-                    idx = jnp.nonzero(surv, size=c_cap,
-                                      fill_value=c_cap - 1)[0]
-                    keep = jnp.arange(c_cap) < nc
-                    cand2 = jnp.where(keep, cand[idx], b_max) \
-                        .astype(jnp.int32)
-                    off2 = jnp.where(keep, 1, 0).astype(jnp.int32)
+                    # survivor list + its chunk cursor through ONE
+                    # shared index (ops.compaction fuses the pair)
+                    _, (cand2, off2) = scatter_compact(
+                        surv, (cand, jnp.ones((c_cap,), jnp.int32)),
+                        c_cap, (b_max, 0))
                     rem8 = jnp.where(surv, degc_l[lv] - 1, 0) \
                         .sum(dtype=jnp.int32)
                     return cand2, off2, rem8
@@ -413,13 +418,12 @@ def _bu_start_sh():
                 return (dist[None], fbits[None], cand2[None], off2[None],
                         jnp.stack([nc, rem8])[None], prog_max)
 
-            return jax.shard_map(
+            return _shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(P(), P(VERTEX_AXIS, None, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS), P(VERTEX_AXIS)),
                 out_specs=(P(VERTEX_AXIS, None),) * 5 + (P(),),
-                check_vma=False,
             )(dist, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
         return bu0
     return jit_once("shbfs_bu0", build)
@@ -464,12 +468,8 @@ def _bu_more_sh():
                     dist = dist.at[jnp.where(found, lv + lo, n_ + 1)] \
                         .set(level + 1, mode="drop")
                     surv = alive & ~found & (off + 1 < degc_l[lv])
-                    idx = jnp.nonzero(surv, size=c_cap,
-                                      fill_value=c_cap - 1)[0]
-                    nc = surv.sum().astype(jnp.int32)
-                    keep = jnp.arange(c_cap) < nc
-                    cand = jnp.where(keep, cand[idx], b_max)
-                    off = jnp.where(keep, off[idx] + 1, 0)
+                    nc, (cand, off) = scatter_compact(
+                        surv, (cand, off + 1), c_cap, (b_max, 0))
                     return (dist, cand, off, nc), None
 
                 (dist, cand, off, c_count), _ = jax.lax.scan(
@@ -486,7 +486,7 @@ def _bu_more_sh():
                 return (dist[None], cand[None], off[None],
                         jnp.stack([c_count, rem])[None], prog_max)
 
-            return jax.shard_map(
+            return _shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
@@ -494,7 +494,6 @@ def _bu_more_sh():
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS),
                           P(VERTEX_AXIS, None, None)),
                 out_specs=(P(VERTEX_AXIS, None),) * 4 + (P(),),
-                check_vma=False,
             )(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, colstart_sh,
               degc_sh, lo_sh, dstT_sh)
         return bu
@@ -544,14 +543,14 @@ def _bu_exhaust_sh():
                     level + 1, mode="drop")
                 return dist[None]
 
-            return jax.shard_map(
+            return _shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS),
                           P(VERTEX_AXIS, None, None)),
-                out_specs=P(VERTEX_AXIS, None), check_vma=False,
+                out_specs=P(VERTEX_AXIS, None),
             )(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, colstart_sh,
               degc_sh, lo_sh, dstT_sh)
         return ex
@@ -607,7 +606,10 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
     from titan_tpu.utils.jitcache import dev_scalar
 
     f_count = 1
-    m8_f = int(np.asarray(degc[source_dense]))
+    # host numpy read — an eager device gather here would be a tunnel
+    # round trip on TPU and is outright unsupported on process-spanning
+    # CPU meshes (the multihost dryrun's first failure point)
+    m8_f = int(sh["degc"][source_dense])
     m8_unvis = total_chunks - m8_f
     nunv_chip = sh["nunv_chip_max"]
     m8f_chip = m8_f

@@ -110,216 +110,116 @@ def _colowner(g):
     return co
 
 
-def _wrap_plan(kind: str):
-    """Build the round plan in ONE readback — pure elementwise + scan
-    work (NO n-scale nonzero, NO random gathers: the round-1 design
-    gathered ``degc[frontier]`` at cap scale, ~1s/round at scale 26
-    against the 67M elem/s big-table regime, which dominated fine-delta
-    runs). The frontier is never materialized as a list: slices are
-    VERTEX RANGES whose in-bucket chunk mass is ~SLICE_BUDGET_CHUNKS
-    (one masked cumsum + k_max searchsorteds), and each push slice
-    recomputes the membership mask for its contiguous range."""
+def _band_plan(kind: str):
+    """Round plan for EVERY scheduler mode — ONE dispatch, one
+    readback, built on ``ops.compaction.banded_frontier``: membership
+    mask -> compacted in-band list + per-member masses (shared-index
+    double scatter: NO n-wide nonzero, NO f_cap-wide ``degc[flist]``
+    re-gather — the r5 quantile plan paid both, ~1.1s/round at scale
+    26) + mass-balanced segment bounds. With ``quantile_mass`` > 0
+    (float32 kinds only) the band threshold is computed ON DEVICE by a
+    two-level histogram so the band carries ~that much chunk mass;
+    otherwise the threshold is the caller's ``bucket_end`` (the
+    delta-stepping bucket top, or the +inf sentinel for the plain
+    expand-everything frontier). ``f_cap`` is ONE compile bucket per
+    scheduler mode (QUANT_LIST_CAP for quantile bands, full w_max for
+    plain/delta so a dense round keeps one-round coverage — see
+    _frontier_run); an in-band set larger than f_cap is truncated by
+    the compaction, which is SOUND: unlisted vertices stay improved
+    (val < val_exp) and the next round re-plans them. The
+    listed-mass cumsum runs in int64 when x64 is enabled and is
+    overflow-flagged otherwise (ADVICE r5 #3): stats[2] nonzero means
+    the segment bounds are corrupt and the host must refuse the round.
+    The list/bounds/threshold are returned ON DEVICE: push segments
+    read them via pooled index scalars, so the host never ships
+    per-segment values (each scalar put is a ~0.1-0.9s tunnel round
+    trip)."""
     def build():
         import jax
         import jax.numpy as jnp
 
-        @functools.partial(jax.jit,
-                           static_argnames=("n_", "k_max", "budget"))
-        def wrapplan(val, val_exp, degc, bucket_end, n_: int, k_max: int,
-                     budget: int):
-            # plain / delta-stepping plan; the priority-batched
-            # (quantile) mode has its own merged single-dispatch plan,
-            # _quant_plan
-            hasdeg = degc[:n_] > 0
-            changed = (val[:n_] < val_exp[:n_]) & hasdeg
-            inb = changed & (val[:n_] < bucket_end)
-            nf = inb.sum().astype(jnp.int32)
-            cummass = jnp.cumsum(
-                jnp.where(inb, degc[:n_], 0), dtype=jnp.int32)
-            m8 = cummass[-1]
-            # vertex-space boundaries on an ABSOLUTE mass schedule —
-            # one BATCHED searchsorted (a sequential fori of dependent
-            # searchsorteds measured ~0.8s/plan at scale 26; this is the
-            # empty-round floor). A >budget hub makes consecutive bounds
-            # equal (slice still <= budget + max_degc); the host skips
-            # zero-width slices and splits over-wide ones.
-            targets = jnp.arange(1, k_max + 1, dtype=jnp.int32) * budget
-            bounds = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32),
-                 jnp.searchsorted(cummass, targets,
-                                  side="right").astype(jnp.int32)])
-            bounds = jnp.minimum(bounds, jnp.int32(n_))
-            bmass = jnp.where(bounds > 0,
-                              cummass[jnp.maximum(bounds - 1, 0)], 0)
-            # pending = improved vertices parked above the bucket; their
-            # minimum value tells the host where the next bucket starts
-            pending = changed & ~inb
-            big = jnp.asarray(FINF if val.dtype == jnp.float32 else IINF,
-                              val.dtype)
-            pmin = jnp.min(jnp.where(pending, val[:n_], big))
-            plan = jnp.concatenate(
-                [jnp.stack([nf, m8]), bounds, bmass,
-                 jax.lax.bitcast_convert_type(pmin, jnp.int32)[None]
-                 if val.dtype == jnp.float32 else pmin[None]])
-            # bounds (and the effective bucket threshold — quantile mode
-            # computes it on device) returned separately ON DEVICE: push
-            # slices read their vertex range / threshold from them via
-            # pooled index scalars, so the host never ships per-slice
-            # values (each scalar put is a ~0.1-0.9s tunnel round trip)
-            return plan, bounds, jnp.asarray(bucket_end, val.dtype)
-        return wrapplan
-    return jit_once(f"frontier_wrapplan_{kind}", build)
-
-
-def _push_slice(kind: str):
-    """One vertex-range SLICE of a frontier-push round: recompute the
-    in-bucket membership mask over [vlo, vhi) from live state (all
-    contiguous dynamic_slice reads — no random gathers outside the
-    essential neighbor fetch/relax), expand the members' chunks, relax
-    min(value) into neighbors, and record the pushed values in
-    ``val_exp``. A member whose chunk range does not fit p_cap (possible
-    when an earlier slice of the same round improved a vertex INTO the
-    bucket after planning) is left unexpanded — still improved, so the
-    next plan picks it up; partial pushes can never mark a vertex
-    expanded."""
-    def build():
-        import jax
-        import jax.numpy as jnp
-
-        @functools.partial(jax.jit,
-                           static_argnames=("f_cap", "p_cap", "n_"),
-                           donate_argnums=(0, 1))
-        def push(val, val_exp, bounds, idx, sub, bucket_end, dstT,
-                 colstart, degc, wparams, f_cap: int, p_cap: int,
-                 n_: int):
-            # the slice's vertex range comes from the DEVICE bounds
-            # array (idx/sub are pooled scalars — no per-call host
-            # transfers): range = width-window `sub` of plan slice `idx`
-            vlo = bounds[idx] + sub * f_cap
-            vhi = jnp.minimum(bounds[idx + 1], vlo + f_cap)
-            # clamp so the dynamic_slice fits; validity is expressed in
-            # GLOBAL vertex indices so the clamp shift cannot re-process
-            # earlier vertices or skip the tail
-            v0 = jnp.minimum(vlo, jnp.int32(n_ + 1 - f_cap))
-            v0 = jnp.maximum(v0, 0)
-            idx = v0 + jnp.arange(f_cap, dtype=jnp.int32)
-            valv = jax.lax.dynamic_slice(val, (v0,), (f_cap,))
-            vexp = jax.lax.dynamic_slice(val_exp, (v0,), (f_cap,))
-            degr = jax.lax.dynamic_slice(degc, (v0,), (f_cap,))
-            colr = jax.lax.dynamic_slice(colstart, (v0,), (f_cap,))
-            member = (idx >= vlo) & (idx < vhi) & (idx < n_) \
-                & (valv < vexp) & (valv < bucket_end) & (degr > 0)
-            counts = jnp.where(member, degr, 0).astype(jnp.int32)
-            # only members whose WHOLE chunk range fits p_cap may be
-            # marked expanded (see docstring)
-            ends = jnp.cumsum(counts)
-            fits = member & (ends <= p_cap)
-            vexp2 = jnp.where(fits, valv, vexp)
-            val_exp = jax.lax.dynamic_update_slice(val_exp, vexp2, (v0,))
-            cols, _, owner = enumerate_chunk_pairs(
-                fits, counts, colr, p_cap, dstT.shape[1] - 1,
-                with_owner=True)
-            src_val = valv[owner]                     # [p_cap], 32MB table
-            nbr = jnp.take(dstT, cols, axis=1)        # [8, p_cap], pad n+1
-            if kind == "sssp":
-                lane = jnp.arange(8, dtype=jnp.int32)[:, None]
-                slot = cols[None, :] * 8 + lane
-                w = _hash_weight_expr(slot, wparams[0], wparams[1])
-                msg = src_val[None, :] + w
-            else:
-                msg = jnp.broadcast_to(src_val[None, :], nbr.shape)
-            return val.at[nbr].min(msg, mode="drop"), val_exp
-        return push
-    return jit_once(f"frontier_push_{kind}", build)
-
-
-def _quant_plan(kind: str):
-    """Quantile-mode round plan in ONE dispatch: 2-level histogram
-    threshold + in-band list compaction + mass-balanced segment bounds
-    (r4 split this across two kernels — threshold in the wrap plan,
-    list build in a second dispatch — paying an extra n-scale pass and
-    a dispatch/sync per round, ~0.4s of the measured ~2s/round overhead
-    at scale 26). ``f_cap`` is a FIXED
-    module-level width (one compile bucket); an in-band set larger than
-    f_cap is truncated by the nonzero, which is SOUND: unlisted vertices
-    stay improved (val < val_exp) and the next round re-plans them."""
-    def build():
-        import jax
-        import jax.numpy as jnp
+        from titan_tpu.ops.compaction import banded_frontier
 
         @functools.partial(jax.jit,
                            static_argnames=("n_", "f_cap", "k_max",
                                             "budget", "quantile_mass",
                                             "bins"))
-        def qplan(val, val_exp, degc, n_: int, f_cap: int, k_max: int,
-                  budget: int, quantile_mass: int, bins: int = 512):
+        def bplan(val, val_exp, degc, bucket_end, n_: int, f_cap: int,
+                  k_max: int, budget: int, quantile_mass: int,
+                  bins: int = 512):
             hasdeg = degc[:n_] > 0
             changed = (val[:n_] < val_exp[:n_]) & hasdeg
-            big_ = jnp.asarray(FINF, val.dtype)
-            vals = jnp.where(changed, val[:n_], big_)
-            lo = vals.min()
-            hi0 = jnp.where(changed, val[:n_], -big_).max()
-            span = jnp.maximum(hi0 - lo, 1e-30)
-            mass = jnp.where(changed, degc[:n_], 0)
-            b = jnp.clip(((val[:n_] - lo) / span
-                          * bins).astype(jnp.int32), 0, bins - 1)
-            b = jnp.where(changed, b, bins - 1)
-            hist = jnp.zeros((bins,), jnp.int32).at[b].add(mass,
-                                                          mode="drop")
-            cum = jnp.cumsum(hist)
-            pick = jnp.minimum(jnp.searchsorted(
-                cum, jnp.int32(quantile_mass), side="left"), bins - 1)
-            lo2 = lo + span * pick.astype(val.dtype) / bins
-            span2 = span / bins
-            before = jnp.where(pick > 0, cum[jnp.maximum(pick - 1, 0)], 0)
-            in2 = changed & (b == pick)
-            b2 = jnp.clip(((val[:n_] - lo2) / span2
-                           * bins).astype(jnp.int32), 0, bins - 1)
-            hist2 = jnp.zeros((bins,), jnp.int32).at[
-                jnp.where(in2, b2, bins - 1)].add(
-                jnp.where(in2, degc[:n_], 0), mode="drop")
-            cum2 = jnp.cumsum(hist2)
-            pick2 = jnp.minimum(jnp.searchsorted(
-                cum2, jnp.int32(quantile_mass) - before, side="left"),
-                bins - 1)
-            thr = lo2 + span2 * (pick2 + 1).astype(val.dtype) / bins
-            thr = jnp.maximum(thr, jnp.nextafter(lo, big_))
+            big_ = jnp.asarray(FINF if val.dtype == jnp.float32
+                               else IINF, val.dtype)
+            if quantile_mass:
+                # two-level histogram threshold (the straddling bin is
+                # re-histogrammed = bins^2 resolution — one 512-bin pass
+                # over power-law value concentrations overshot the
+                # target mass up to 10x, PERF_NOTES r5)
+                vals = jnp.where(changed, val[:n_], big_)
+                lo = vals.min()
+                hi0 = jnp.where(changed, val[:n_], -big_).max()
+                span = jnp.maximum(hi0 - lo, 1e-30)
+                mass = jnp.where(changed, degc[:n_], 0)
+                b = jnp.clip(((val[:n_] - lo) / span
+                              * bins).astype(jnp.int32), 0, bins - 1)
+                b = jnp.where(changed, b, bins - 1)
+                hist = jnp.zeros((bins,), jnp.int32).at[b].add(
+                    mass, mode="drop")
+                cum = jnp.cumsum(hist)
+                pick = jnp.minimum(jnp.searchsorted(
+                    cum, jnp.int32(quantile_mass), side="left"),
+                    bins - 1)
+                lo2 = lo + span * pick.astype(val.dtype) / bins
+                span2 = span / bins
+                before = jnp.where(pick > 0,
+                                   cum[jnp.maximum(pick - 1, 0)], 0)
+                in2 = changed & (b == pick)
+                b2 = jnp.clip(((val[:n_] - lo2) / span2
+                               * bins).astype(jnp.int32), 0, bins - 1)
+                hist2 = jnp.zeros((bins,), jnp.int32).at[
+                    jnp.where(in2, b2, bins - 1)].add(
+                    jnp.where(in2, degc[:n_], 0), mode="drop")
+                cum2 = jnp.cumsum(hist2)
+                pick2 = jnp.minimum(jnp.searchsorted(
+                    cum2, jnp.int32(quantile_mass) - before,
+                    side="left"), bins - 1)
+                thr = lo2 + span2 * (pick2 + 1).astype(val.dtype) / bins
+                thr = jnp.maximum(thr, jnp.nextafter(lo, big_))
+            else:
+                thr = jnp.asarray(bucket_end, val.dtype)
 
             inb = changed & (val[:n_] < thr)
-            flist = jnp.nonzero(inb, size=f_cap,
-                                fill_value=n_)[0].astype(jnp.int32)
-            valid = flist < n_
-            nf = valid.sum().astype(jnp.int32)
-            degl = jnp.where(valid, degc[jnp.minimum(flist, n_)], 0)
-            cmass = jnp.cumsum(degl.astype(jnp.int32))
-            m8 = cmass[-1]                       # LISTED mass
-            targets = jnp.arange(1, k_max + 1, dtype=jnp.int32) * budget
-            lb = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32),
-                 jnp.minimum(jnp.searchsorted(cmass, targets,
-                                              side="right"),
-                             f_cap).astype(jnp.int32)])
+            # degc is passed RAW as the mass payload — the compaction
+            # only lands masked entries, so no where() pre-mask needed
+            nf, m8, overflow, flist, lb = banded_frontier(
+                inb, degc[:n_], f_cap, k_max, budget, n_)
+            # pending = improved vertices parked above the threshold;
+            # their minimum tells the host where the next bucket starts
             pending = changed & ~inb
             pmin = jnp.min(jnp.where(pending, val[:n_], big_))
             stats = jnp.concatenate(
-                [jnp.stack([nf, m8]),
-                 jax.lax.bitcast_convert_type(pmin, jnp.int32)[None]])
+                [jnp.stack([nf, m8, overflow]),
+                 jax.lax.bitcast_convert_type(pmin, jnp.int32)[None]
+                 if val.dtype == jnp.float32 else pmin[None]])
             return stats, flist, lb, jnp.asarray(thr, val.dtype)
-        return qplan
-    return jit_once(f"frontier_quantplan_{kind}", build)
+        return bplan
+    return jit_once(f"frontier_bandplan_{kind}", build)
 
 
-# fixed in-band list width for the merged quantile plan (one compile
-# bucket; truncation is sound — see _quant_plan)
+# fixed in-band list width for the merged band plan (one compile
+# bucket; truncation is sound — see _band_plan)
 QUANT_LIST_CAP = 1 << 23
 
 
 def _push_list(kind: str):
     """Push one mass-balanced SEGMENT of the round's compacted in-band
-    list (quantile mode). Membership is rechecked live (an earlier
-    segment may have improved a member further — it pushes its current
-    value); a vertex appears in exactly one segment and segment mass is
-    fixed by the plan, so p_cap = pow2(segment mass) never defers."""
+    list (every mode — quantile band, delta bucket, or the plain
+    improved-set frontier; the threshold device scalar encodes the
+    difference). Membership is rechecked live (an earlier segment may
+    have improved a member further — it pushes its current value); a
+    vertex appears in exactly one segment and segment mass is fixed by
+    the plan, so p_cap = pow2(segment mass) never defers."""
     def build():
         import jax
         import jax.numpy as jnp
@@ -388,10 +288,6 @@ def _max_degc(g) -> int:
     return got
 
 
-# vertex-range slice width: sparse rounds dispatch >= n/width slices, so
-# width trades dispatch count against the src_val gather table size
-# (2^23 int32 = 32MB, the last fast-gather size — see PERF_NOTES.md)
-SLICE_WIDTH = 1 << 23
 # default per-round band mass (chunks) for quantile-batched SSSP — the
 # measured r5 winner and the DEFAULT mode: scale-26 warm, same chip-day:
 # plain 247s / 1118M chunks vs quantile-2^24 121-130s / 394M chunks
@@ -407,34 +303,37 @@ QUANTILE_MASS_DEFAULT = 1 << 24
 def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
                   max_rounds: int, delta: float | None = None,
                   quantile_mass: int = 0):
-    """Expansion-tracked round loop: one plan readback per round, then
-    budget-bounded vertex-range push dispatches. With ``delta``, rounds
-    expand only the current distance bucket (one-sided) and the bucket
-    advances to the minimum pending value when it drains —
-    delta-stepping. With ``quantile_mass``, each round's threshold is
-    computed ON DEVICE so the expanded band carries ~that much chunk
-    mass — priority-batched expansion in near-sorted value order (see
-    _wrap_plan). Without either, every improved vertex is eligible
-    every round."""
+    """Expansion-tracked round loop: one plan readback per round
+    (_band_plan — compacted in-band list + mass-balanced segment
+    bounds, no n-wide nonzero), then one _push_list dispatch per
+    ~budget chunks of listed mass. With ``delta``, rounds expand only
+    the current distance bucket (one-sided) and the bucket advances to
+    the minimum pending value when it drains — delta-stepping. With
+    ``quantile_mass``, each round's threshold is computed ON DEVICE so
+    the expanded band carries ~that much chunk mass — priority-batched
+    expansion in near-sorted value order. Without either, every
+    improved vertex is in-band every round (threshold = the +inf
+    sentinel)."""
+    import time as _time
+
     import jax.numpy as jnp
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
     n = g["n"]
     dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
-    push = _push_slice(kind)
-    wrapplan = _wrap_plan(kind)
+    plan = _band_plan(kind)
+    pushl = _push_list(kind)
     max_dc = _max_degc(g)
     is_f32 = val.dtype == jnp.float32
     big = float(FINF) if is_f32 else int(IINF)
-    # dynamic_slice needs f_cap <= n+1: cap the range width at the
-    # largest power of two that fits the state arrays
+    # the in-band list never usefully exceeds the vertex count: cap its
+    # width at the largest power of two that fits the state arrays
     w_max = 1 << ((n + 1).bit_length() - 1)
-    width = min(SLICE_WIDTH, w_max)
-    # a slice carries up to budget + max_dc chunks (one vertex of
+    # a segment carries up to budget + max_dc chunks (one vertex of
     # overshoot), so budget == 2^k would push p_cap to 2^(k+1) and HALF
-    # of every big slice's lanes would be padding — shave max_dc off the
-    # budget so full slices fit a 2^k kernel exactly (measured
+    # of every big segment's lanes would be padding — shave max_dc off
+    # the budget so full segments fit a 2^k kernel exactly (measured
     # 2026-07-31: scale-26 SSSP round cost is dominated by these lanes)
     target = _next_pow2(max(SLICE_BUDGET_CHUNKS, 2))
     if max_dc <= target // 2:
@@ -445,7 +344,7 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
         p_full = _next_pow2(max(budget + max_dc, 2))
 
     wp = jnp.asarray(np.asarray(wparams, np.float32))
-    # the quantile threshold math in _wrap_plan is float32-only (span
+    # the quantile threshold math in _band_plan is float32-only (span
     # floor 1e-30, jnp.nextafter on lo); int-valued kinds (e.g. WCC
     # labels) would trace-error or mis-threshold — fall back to the
     # plain improved-set frontier for them
@@ -455,117 +354,95 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
     trace = g.get("_trace_rounds")      # optional perf instrumentation:
     rounds = 0                          # set g["_trace_rounds"] = [] to
     dtname = "float32" if is_f32 else "int32"
-    prev_sig = None
-    escalate = False
-    qf_cap = min(QUANT_LIST_CAP, w_max)
-    while rounds < max_rounds:          # collect (bucket_end, nf, m8)
-        if quantile_mass:
-            # priority-batched mode: ONE merged plan dispatch
-            # (threshold + in-band list + segment bounds, _quant_plan)
-            # then a pushl per ~budget chunks of listed mass. Expansion
-            # happens in near-sorted value order — the Dijkstra
-            # no-re-expansion property, batched; exactness is
-            # val_exp-tracked and does not depend on the threshold.
-            qplan = _quant_plan(kind)
-            pushl = _push_list(kind)
-            stats, flist, lbounds, thr_dev = qplan(
-                val, val_exp, degc, n_=n, f_cap=qf_cap,
-                k_max=SLICE_K_MAX, budget=budget,
-                quantile_mass=quantile_mass)
-            st_h = np.asarray(stats)       # ONE sync per round
-            nf, m8 = int(st_h[0]), int(st_h[1])
-            pmin = st_h[2:3].view(np.float32)[0]
-            if trace is not None:
-                import time as _t
-                trace.append((0.0, nf, m8, _t.time()))
-            if nf == 0 or m8 == 0:
-                if float(pmin) >= big * (1 - 1e-6):
-                    return val[:n], rounds   # no pending work anywhere
-                # the device threshold always includes the minimum
-                # value, so an empty round with pending work cannot
-                # recur — guard fp corner-cases by escalating to plain
-                quantile_mass = 0
-                continue
-            sig_q = (nf, m8, float(pmin))
-            if sig_q == prev_sig:
-                # two identical rounds = every member was fits-deferred
-                # (pathological segment packing) — permanently fall
-                # back to the vertex-range path, whose escalate
-                # handling is proven
-                quantile_mass = 0
-                continue
-            prev_sig = sig_q
-            nseg = min(-(-m8 // budget), SLICE_K_MAX)
-            # f bucket quantized to powers of FOUR: per-nf pow2 buckets
-            # compiled a fresh kernel per distinct band size (measured
-            # scale 26: seven one-call pushlist compiles at ~17s each
-            # through the remote-compile tunnel — more compile than
-            # push). A segment holds at most ~budget vertices.
-            f_bucket = _quantize_cap(min(nf, budget + max_dc), qf_cap)
-            for k in range(nseg):
-                # +max_dc headroom: a vertex straddling the mass target
-                # lands wholly in one segment (full segments then size
-                # to exactly p_full — the budget is pre-shaved by
-                # max_dc, see above)
-                mass_k = min(budget, m8 - k * budget) + max_dc
-                p_cap = _quantize_cap(mass_k, p_full)
-                fk = min(f_bucket, p_cap)
-                val, val_exp = pushl(
-                    val, val_exp, flist, lbounds, dev_scalar(k),
-                    thr_dev, dstT, colstart, degc, wp,
-                    f_cap=fk, p_cap=p_cap, n_=n)
-            rounds += 1
-            continue
+    prev_sig = None                     # collect per-round 5-tuples
+    # plan-cost isolation drain: opt-in SEPARATELY from the trace — it
+    # buys exact per-round plan numbers at one extra host round trip
+    # per round (0.1-0.9s each through the tunnel), which the plain
+    # mass-accounting trace consumers must not pay
+    drain = trace is not None and g.get("_trace_plan_drain")
+    while rounds < max_rounds:
+        # list width: quantile mode caps at QUANT_LIST_CAP (the band
+        # carries ~quantile_mass chunks, so members are bounded and
+        # truncation only defers); plain/delta modes must cover EVERY
+        # improved vertex in one round when possible (a dense WCC round
+        # lists up to n members — capping it at 2^23 would multiply
+        # round count by n/2^23, each paying the plan sync), so they
+        # list at full w_max width — per-round coverage is then bounded
+        # by nseg exactly like the r5 vertex-range path (64 x budget
+        # chunks). Computed per round: a quantile->plain escalation
+        # flips it (one extra plan compile, rare fp corner).
+        qf_cap = min(QUANT_LIST_CAP, w_max) if quantile_mass else w_max
+        if drain:
+            # drain the queued pushes first so the plan sync below
+            # measures the plan alone, not their completion
+            val.block_until_ready()
+        t_plan = _time.time()
         be_dev = dev_scalar(bucket_end, dtname)
-        plan, bounds_dev, thr_dev = wrapplan(
-            val, val_exp, degc, be_dev, n_=n, k_max=SLICE_K_MAX,
-            budget=budget)
-        plan_h = np.asarray(plan)          # ONE sync per round
-        nf, m8 = (int(x) for x in plan_h[:2])
-        bounds = plan_h[2:2 + SLICE_K_MAX + 1]
-        bmass = plan_h[3 + SLICE_K_MAX:3 + 2 * SLICE_K_MAX + 1]
-        pmin = plan_h[-1].view(np.float32) if is_f32 else plan_h[-1]
+        stats, flist, lbounds, thr_dev = plan(
+            val, val_exp, degc, be_dev, n_=n, f_cap=qf_cap,
+            k_max=SLICE_K_MAX, budget=budget,
+            quantile_mass=quantile_mass)
+        st_h = np.asarray(stats)           # ONE sync per round
+        plan_s = _time.time() - t_plan
+        nf, m8 = int(st_h[0]), int(st_h[1])
+        if int(st_h[2]):
+            raise RuntimeError(
+                "banded_frontier: listed chunk mass overflowed int32 — "
+                "segment bounds are corrupt (enable JAX x64 or shard "
+                "the graph below 2^31 chunks)")
+        pmin = st_h[3:4].view(np.float32)[0] if is_f32 else st_h[3]
         if trace is not None:
-            import time as _t
-            trace.append((float(bucket_end), nf, m8, _t.time()))
+            trace.append((0.0 if quantile_mass else float(bucket_end),
+                          nf, m8, _time.time(), plan_s))
         if nf == 0 or m8 == 0:
             if float(pmin) >= big * (1 - 1e-6):
                 return val[:n], rounds     # no pending work anywhere
-            # bucket drained: advance to the minimum pending value's
-            # bucket (strictly increases — pmin >= current bucket_end)
-            bucket_end = float((np.floor(float(pmin) / delta) + 1)
-                               * delta)
-            continue
-        # a round that changed NOTHING means every remaining member was
-        # fits-deferred (its chunk range exceeded the tight p_cap) —
-        # escalate to full-size kernels for one round
-        sig = (nf, m8, float(pmin), float(bucket_end))
+            if quantile_mass:
+                # the device threshold always includes the minimum
+                # value, so an empty round with pending work cannot
+                # recur — guard fp corner-cases by escalating to the
+                # direct-threshold (expand-everything) mode
+                quantile_mass = 0
+                continue
+            if delta and delta > 0:
+                # bucket drained: advance to the minimum pending
+                # value's bucket (strictly increases — pmin >= current
+                # bucket_end)
+                bucket_end = float((np.floor(float(pmin) / delta) + 1)
+                                   * delta)
+                continue
+            # plain mode admits every improved vertex: pending work
+            # with an empty band means corrupt state — fail loudly
+            # rather than spin
+            raise RuntimeError(
+                f"frontier_{kind}: empty round with pending work "
+                f"(pmin={pmin!r}) in plain mode")
+        # a round that changed NOTHING means every listed member was
+        # deferred (pathological packing) — escalate to full-size
+        # kernels for one round
+        sig = (nf, m8, float(pmin), float(bucket_end), quantile_mass)
         escalate = sig == prev_sig
         prev_sig = sig
-        for i in range(SLICE_K_MAX):
-            vlo, vhi = int(bounds[i]), int(bounds[i + 1])
-            # equal bounds = a >budget hub straddling the target (or
-            # coverage exhausted); zero-mass slices carry no members
-            if vhi <= vlo or int(bmass[i + 1]) == int(bmass[i]):
-                continue
-            # per-slice p_cap from the plan's mass column: a kernel
-            # pays its FULL p_cap whether or not lanes are live
-            # (measured 1.15s for a ZERO-mass 2^23 dispatch, 0.2s at
-            # 2^18), so sparse slices get kernels sized to their mass.
-            # No max_dc pad: a member whose chunks exceed p_cap is
-            # fits-deferred, and the stall signature above escalates.
-            mass_i = int(bmass[i + 1]) - int(bmass[i])
-            p_cap = p_full if escalate \
-                else _quantize_cap(mass_i, p_full)
-            # device-side width split: sub index selects a width-window
-            # of slice i, both from the scalar pool — no host puts
-            for j in range((vhi - vlo + width - 1) // width):
-                # quantile rounds never reach here (their branch ends
-                # in `continue`; the stall fallback zeroes the mode)
-                val, val_exp = push(
-                    val, val_exp, bounds_dev, dev_scalar(i),
-                    dev_scalar(j), be_dev, dstT, colstart, degc, wp,
-                    f_cap=width, p_cap=p_cap, n_=n)
+        nseg = min(-(-m8 // budget), SLICE_K_MAX)
+        # f bucket quantized to powers of FOUR: per-nf pow2 buckets
+        # compiled a fresh kernel per distinct band size (measured
+        # scale 26: seven one-call pushlist compiles at ~17s each
+        # through the remote-compile tunnel — more compile than
+        # push). A segment holds at most ~budget vertices.
+        f_bucket = _quantize_cap(min(nf, budget + max_dc), qf_cap)
+        for k in range(nseg):
+            # +max_dc headroom: a vertex straddling the mass target
+            # lands wholly in one segment (full segments then size
+            # to exactly p_full — the budget is pre-shaved by
+            # max_dc, see above)
+            mass_k = min(budget, m8 - k * budget) + max_dc
+            p_cap = p_full if escalate else _quantize_cap(mass_k, p_full)
+            fk = min(qf_cap, p_full) if escalate \
+                else min(f_bucket, p_cap)
+            val, val_exp = pushl(
+                val, val_exp, flist, lbounds, dev_scalar(k),
+                thr_dev, dstT, colstart, degc, wp,
+                f_cap=fk, p_cap=p_cap, n_=n)
         rounds += 1
     return val[:n], rounds
 

@@ -200,6 +200,19 @@ def bulk_load_adjacency(graph, src: np.ndarray, dst: np.ndarray,
         lens = np.diff(col_offs)
         K = int(lens.max() - P) if m else 0
     if packed and K <= 16:
+        # the packed path slots the exists column before/after ALL edge
+        # columns by one byte-compare — only sound while category codes
+        # are prefix-free AND differ in their first byte (a codec change
+        # that shares the leading byte would interleave edge columns
+        # around the exists column, and mutate_row_packed adopts rows
+        # verbatim, silently breaking sliced reads — ADVICE r5 #4)
+        if exists_col[:1] == edge_prefix[:1]:
+            raise AssertionError(
+                "packed bulk path: vertex-exists and edge category "
+                "prefixes share their first byte "
+                f"({exists_col[:1]!r}) — within-row byte order is no "
+                "longer decided by the category slot; fix the codec "
+                "prefixes or disable features.packed_ops")
         # packed bulk path: rows are adopted whole, so columns must
         # arrive byte-sorted. All edge columns share the category
         # prefix, so the within-row order is decided by the <=16

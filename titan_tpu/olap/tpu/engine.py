@@ -276,12 +276,12 @@ def _run_sharded_csr(program: DenseProgram, sc: ShardedCSR, params: dict,
             cond, superstep, (state, jnp.int32(0), jnp.array(False)))
         return state, iters
 
-    mapped = jax.jit(jax.shard_map(
+    from titan_tpu.parallel.mesh import shard_map_compat
+    mapped = jax.jit(shard_map_compat(
         per_device, mesh=mesh,
         in_specs=({k: vspec for k in state0}, espec, espec, espec, espec,
                   espec, {k: espec for k in sorted(wanted_edata)}),
-        out_specs=({k: vspec for k in state0}, P()),
-        check_vma=False))
+        out_specs=({k: vspec for k in state0}, P())))
 
     dev = getattr(sc, "_dev", None)
     if dev is None:

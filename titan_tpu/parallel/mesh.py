@@ -17,6 +17,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 VERTEX_AXIS = "v"
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """Version-spanning shard_map: ``jax.shard_map`` (new spelling) when
+    present, ``jax.experimental.shard_map`` otherwise. Replication
+    checking is disabled either way (check_vma/check_rep) — the engine
+    kernels return deliberately-replicated pmax'd stats next to sharded
+    state, which the checker rejects."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def vertex_mesh(num_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     if num_devices is None or num_devices <= 0:

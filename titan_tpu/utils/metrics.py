@@ -164,6 +164,17 @@ class MetricManager:
                     w.writerow([name, val, "", "", ""])
 
 
+# live reporters keyed by (manager identity, sink identity): two graphs
+# opened with the same reporter config over the process-global registry
+# SHARE one reporter thread instead of each emitting the full shared
+# snapshot (duplicate console/CSV/Graphite streams — ADVICE r5 #5); the
+# shared reporter is refcounted so closing one graph doesn't silence
+# the other. Entries are evicted on final stop (under the lock) so
+# long-lived servers cycling graph opens don't pin dead reporters.
+_ACTIVE_REPORTERS: dict = {}
+_ACTIVE_LOCK = threading.Lock()
+
+
 class ScheduledReporter:
     """Background daemon thread that emits a metrics snapshot every
     ``interval_s`` seconds (reference: the Dropwizard scheduled
@@ -180,6 +191,15 @@ class ScheduledReporter:
         self.name = name
         self.errors = 0
         self.reports = 0
+        # shared-reporter refcount: start_reporters dedups per
+        # (manager, sink) and hands the SAME reporter to every graph
+        # that asked for it; each graph's close() calls stop(), and
+        # only the LAST stop actually ends the thread. _dedup_key is
+        # set by _shared_reporter so the registry entry is evicted on
+        # final stop; refcount moves happen under _ACTIVE_LOCK (the
+        # same lock _shared_reporter joins under)
+        self._refs = 1
+        self._dedup_key = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"metrics-{name}", daemon=True)
@@ -197,8 +217,25 @@ class ScheduledReporter:
             self.errors += 1
 
     def stop(self, timeout: float = 5.0) -> None:
-        self._stop.set()
+        """Release one acquisition; the last release ends the thread.
+        Call EXACTLY ONCE per start_reporters acquisition while shared
+        (graph.close guards this with its _open flag); once the thread
+        is fully stopped, further stops are idempotent no-ops."""
+        with _ACTIVE_LOCK:
+            if self._stop.is_set():
+                return
+            self._refs -= 1
+            if self._refs > 0:
+                return
+            self._stop.set()
+            if self._dedup_key is not None and \
+                    _ACTIVE_REPORTERS.get(self._dedup_key) is self:
+                del _ACTIVE_REPORTERS[self._dedup_key]
         self._thread.join(timeout)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
 
 
 def _console_emit(stream=None):
@@ -249,10 +286,25 @@ def _graphite_emit(host: str, port: int, prefix: str):
     return emit
 
 
+def _shared_reporter(key, make) -> ScheduledReporter:
+    with _ACTIVE_LOCK:
+        r = _ACTIVE_REPORTERS.get(key)
+        if r is not None and not r.stopped:
+            r._refs += 1
+            return r
+        r = make()
+        r._dedup_key = key
+        _ACTIVE_REPORTERS[key] = r
+        return r
+
+
 def start_reporters(config, manager: Optional["MetricManager"] = None
                     ) -> list[ScheduledReporter]:
     """Start every reporter whose interval option is > 0 (the graph
-    calls this at open and stops them at close)."""
+    calls this at open and stops them at close). Startup is deduped per
+    (manager, sink): a second graph with an identical sink config joins
+    the running reporter's refcount instead of spawning a duplicate
+    stream."""
     from titan_tpu.config import defaults as d
 
     manager = manager or MetricManager.instance()
@@ -260,19 +312,26 @@ def start_reporters(config, manager: Optional["MetricManager"] = None
     out: list[ScheduledReporter] = []
     iv = config.get(d.METRICS_CONSOLE_INTERVAL)
     if iv > 0:
-        out.append(ScheduledReporter(manager, iv, _console_emit(),
-                                     "console"))
+        out.append(_shared_reporter(
+            (id(manager), "console", iv),
+            lambda: ScheduledReporter(manager, iv, _console_emit(),
+                                      "console")))
     iv = config.get(d.METRICS_CSV_INTERVAL)
     if iv > 0:
-        out.append(ScheduledReporter(
-            manager, iv, _csv_emit(config.get(d.METRICS_CSV_DIR)), "csv"))
+        csv_dir = config.get(d.METRICS_CSV_DIR)
+        out.append(_shared_reporter(
+            (id(manager), "csv", iv, csv_dir),
+            lambda: ScheduledReporter(manager, iv, _csv_emit(csv_dir),
+                                      "csv")))
     iv = config.get(d.METRICS_GRAPHITE_INTERVAL)
     if iv > 0:
-        out.append(ScheduledReporter(
-            manager, iv,
-            _graphite_emit(config.get(d.METRICS_GRAPHITE_HOST),
-                           config.get(d.METRICS_GRAPHITE_PORT), prefix),
-            "graphite"))
+        host = config.get(d.METRICS_GRAPHITE_HOST)
+        port = config.get(d.METRICS_GRAPHITE_PORT)
+        out.append(_shared_reporter(
+            (id(manager), "graphite", iv, host, port, prefix),
+            lambda: ScheduledReporter(
+                manager, iv, _graphite_emit(host, port, prefix),
+                "graphite")))
     return out
 
 
