@@ -48,29 +48,48 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1100"))
 HEADLINE_STAGE = "bfs26"
 _T_START = time.time()
 
-# conservative per-stage wall-clock estimates (seconds, accelerator path,
-# warm disk cache / warm XLA cache; measured on-device 2026-07-30 on a
-# SLOW-tunnel day — H2D ran at ~10-20MB/s, so the scale-26 upload alone
-# was 430-830s; fast days are ~10-30x quicker). Used only to decide
-# whether a stage still fits in the budget.
+# per-stage wall-clock estimates: a COMPUTE floor plus an UPLOAD
+# component (GB through the H2D tunnel), so admission can be re-priced
+# against the day's MEASURED tunnel rate instead of a guessed total
+# (VERDICT r5 weak #2: bfs_heavy's flat 300s was a fast-day number on a
+# tunnel PERF_NOTES documents as a ~30x envelope — it was admitted with
+# 402s left and ate the external kill). ``fixed`` covers compiles +
+# compute at a slow-day floor; upload cost = gb / measured rate.
 _EST = {
-    "gods_2hop": 20,
-    "ldbc": 90,
-    "bfs23": 200,        # 1.2GB upload + runs
-    "bfs23_sharded": 400,  # shard upload + per-cap-bucket kernel
-                           # compiles + 2 sharded runs (~5s each) + plain
-    "bfs26": 600,        # 9GB upload + compiles + 3 reps x ~12s
-    "ssspwcc": 300,      # frontier SSSP + BFS-seeded WCC
-    "pagerank": 120,     # 0.6GB upload + compile + 12 iterations
-    "store_ingest": 550,  # packed bulk ingest s22 + native packed scan
-                          # + CSR + BFS (measured in-bench: 578s with
-                          # the s26 graph resident in host RAM; the
-                          # stage is the north-star store->CSR proof
-                          # and outranks the stages after it)
-    "bfs_heavy": 300,    # 11.6GB upload (fast-day) + 2 reps; measured
-                         # 9.97s = 148.1M TEPS when it fits (numbers in
-                         # PERF_NOTES r5 / STATUS)
+    #             fixed_s  upload_gb
+    "gods_2hop": (20,      0.0),
+    "ldbc":      (90,      0.0),
+    "bfs23":     (60,      1.2),
+    "bfs23_sharded": (180, 2.4),   # shard replica + plain copy
+    "bfs26":     (420,     9.0),
+    "ssspwcc":   (300,     0.0),   # shares the resident s26 upload
+    "pagerank":  (60,      0.6),
+    "store_ingest": (550,  0.6),   # s22 ingest+scan is host-bound;
+                                   # scale fallback below re-prices
+    "bfs_heavy": (120,     11.6),  # 2 reps ~10s each + compiles
 }
+# nominal fast-day H2D rate (GB/s): bfs26's 9GB uploaded in 16.35s
+# (BENCH_r05); the headline stage's measured upload re-prices this
+_H2D_NOMINAL_GBPS = 0.55
+_h2d_gbps = _H2D_NOMINAL_GBPS
+# nothing new starts inside this reserve before the external kill
+# (the driver window is observed, not contractual — leave margin for
+# the final emits)
+_HARD_RESERVE_S = 60.0
+
+
+def _est(name: str, on_accel: bool = True) -> float:
+    fixed, gb = _EST.get(name, (60, 0.0))
+    if not on_accel:
+        return fixed
+    return fixed + gb / max(_h2d_gbps, 1e-3)
+
+
+def _observe_h2d(gb: float, seconds: float) -> None:
+    """Re-price the tunnel from a measured upload (headline stage)."""
+    global _h2d_gbps
+    if gb > 0.5 and seconds > 0:
+        _h2d_gbps = max(min(gb / seconds, 2.0), 0.005)
 
 
 def _left() -> float:
@@ -78,7 +97,15 @@ def _left() -> float:
 
 
 class Report:
-    """Cumulative result: emit() prints the full JSON line every time."""
+    """Cumulative result: emit() prints the full JSON line every time.
+
+    ``headline()`` is a ONE-SHOT latch: the first call owns the
+    metric/value/vs_baseline line for the rest of the run and every
+    later call is ignored (VERDICT r5 weak #1: gods_2hop overwrote the
+    scale-26 BFS TEPS headline, so the driver's record reported a 0.137
+    ms OLTP latency as the round's metric while the real 156.8M-TEPS
+    number sat buried in detail — the headline stage runs first
+    precisely so it latches first)."""
 
     def __init__(self) -> None:
         self.metric = "bench_incomplete"
@@ -86,11 +113,15 @@ class Report:
         self.unit = ""
         self.vs_baseline = 0.0
         self.detail: dict = {"skipped": [], "budget_s": BUDGET_S}
+        self._latched = False
 
     def headline(self, metric: str, value: float, unit: str,
                  vs_baseline: float) -> None:
+        if self._latched:
+            return
         self.metric, self.value = metric, value
         self.unit, self.vs_baseline = unit, vs_baseline
+        self._latched = True
 
     def emit(self) -> None:
         self.detail["elapsed_s"] = round(time.time() - _T_START, 1)
@@ -108,13 +139,13 @@ class Report:
 # arrays (9GB) can cost MINUTES through the axon tunnel on a bad day —
 # never upload the same graph twice. ALL bench graphs stay resident
 # (s22 0.56GB + s23 1.12GB + s26 9.03GB = 10.7GB of 16GB HBM, leaving
-# ~3GB for kernel state/temporaries); eviction only under pressure.
-_DEV_GRAPHS: dict = {}
-_HBM_GRAPH_BUDGET = 12.0e9
+# ~3GB for kernel state/temporaries); largest-first eviction only under
+# pressure. The budget/eviction logic is the serving layer's HBM library
+# (olap/serving/hbm.py) — the same accounting the job scheduler admits
+# against, no longer a script-local.
+from titan_tpu.olap.serving.hbm import DeviceGraphCache  # noqa: E402
 
-
-def _graph_bytes(hg) -> float:
-    return hg["q_total"] * 8 * 4 + 3 * 4 * hg["n"]
+_DEV_GRAPHS = DeviceGraphCache(budget_bytes=12.0e9)
 
 
 def _load_device_graph(scale: int, edge_factor: int = 16, seed: int = 2):
@@ -122,24 +153,19 @@ def _load_device_graph(scale: int, edge_factor: int = 16, seed: int = 2):
 
     from titan_tpu.olap.tpu import graph500
 
-    key = (scale, edge_factor, seed)
-    if key in _DEV_GRAPHS:
-        return _DEV_GRAPHS[key] + (0.0, 0.0)
-    t0 = time.time()
-    hg = graph500.load_or_build(scale, edge_factor, seed=seed, verbose=False)
-    gen_s = time.time() - t0
-    # evict largest-first only if the new graph would overflow the budget
-    need = _graph_bytes(hg)
-    resident = {k: _graph_bytes(v[0]) for k, v in _DEV_GRAPHS.items()}
-    while resident and sum(resident.values()) + need > _HBM_GRAPH_BUDGET:
-        victim = max(resident, key=resident.get)
-        _DEV_GRAPHS.pop(victim)
-        resident.pop(victim)
-    t0 = time.time()
-    g = graph500.to_device(hg)
-    jax.block_until_ready(g["dstT"])
-    upload_s = time.time() - t0
-    _DEV_GRAPHS[key] = (hg, g)
+    def upload(hg):
+        g = graph500.to_device(hg)
+        jax.block_until_ready(g["dstT"])
+        return g
+
+    hg, g, gen_s, upload_s = _DEV_GRAPHS.get_or_load(
+        (scale, edge_factor, seed),
+        lambda: graph500.load_or_build(scale, edge_factor, seed=seed,
+                                       verbose=False),
+        upload)
+    if upload_s > 0:
+        from titan_tpu.olap.serving.hbm import graph_bytes
+        _observe_h2d(graph_bytes(hg) / 1e9, upload_s)
     return hg, g, gen_s, upload_s
 
 
@@ -474,7 +500,17 @@ def bfs_heavy_stage(rep: Report) -> None:
         rep.skip("bfs_heavy", "graph cache absent (one-time ~15min "
                  "build: python scripts/build_heavy_graph.py)")
         return
-    r = bfs_teps(25, edge_factor=44, reps=2)
+    # reps fallback: when the day's tunnel rate prices the full stage
+    # out of the remaining budget, one rep still lands a driver-captured
+    # number (the upload dominates — a second rep adds ~10s)
+    reps = 2
+    if _left() < _est("bfs_heavy") + 30:
+        reps = 1
+        rep.detail["bfs_heavy_reps_fallback"] = {
+            "reps": 1, "why": f"{_left():.0f}s left, est "
+                              f"{_est('bfs_heavy'):.0f}s at "
+                              f"{_h2d_gbps:.3f}GB/s"}
+    r = bfs_teps(25, edge_factor=44, reps=reps)
     rep.detail["bfs_heavy_single_chip"] = {
         "substitution": "RMAT s25 ef44 at Twitter-2010 directed-edge "
                         "parity (1.476B vs 1.468B input edges)",
@@ -492,15 +528,46 @@ def bfs_heavy_stage(rep: Report) -> None:
     rep.emit()
 
 
-def store_ingest_stage(rep: Report, scale: int) -> None:
+def store_ingest_stage(rep: Report, scale: int,
+                       smoke: bool = False) -> None:
     """VERDICT r4 #4 / the north-star contract: OLAP over a CSR snapshot
     OF THE EDGE STORE at benchmark scale. Generates an R-MAT edge list,
     bulk-loads it through the storage plane (KCVS mutations via the
     batch-loading path, reference: GraphDatabaseConfiguration
     STORAGE_BATCH), scans the edgestore back into a snapshot
     (native scan), builds the chunked CSR, and runs the SAME BFS —
-    checking the result against the generated-graph BFS."""
+    checking the result against the generated-graph BFS.
+
+    SCALE FALLBACK (ISSUE r7): the stage is host-bound and scales
+    ~linearly with edges, so when the remaining budget can't cover the
+    requested scale it steps down (s22 → s21 → s20) instead of being
+    skipped outright — a smaller driver-captured number beats a third
+    round of no number at all. The chosen scale is recorded."""
     import jax
+
+    fixed, _gb = _EST["store_ingest"]
+    if smoke:                    # CPU/CI scales cost ~1/10th (main())
+        fixed = fixed / 10
+    full_scale = scale
+    candidates = [s for s in range(scale, scale - 3, -1) if s >= 10] \
+        or [scale]
+    chosen = None
+    for s in candidates:
+        # est halves per scale step down (edge count halves; the
+        # +60s covers the fixed BFS/compile tail that doesn't shrink)
+        if _left() > fixed / (2 ** (full_scale - s)) + 60:
+            chosen = s
+            break
+    if chosen is None:
+        rep.skip("store_ingest",
+                 f"budget: {_left():.0f}s left cannot fit even the "
+                 f"s{candidates[-1]} fallback")
+        return
+    scale = chosen
+    if scale != full_scale:
+        rep.detail["store_ingest_scale_fallback"] = {
+            "requested": full_scale, "ran": scale,
+            "why": f"{_left():.0f}s left"}
 
     from titan_tpu.models.bfs import INF
     from titan_tpu.models.bfs_hybrid import (build_chunked_csr,
@@ -664,11 +731,12 @@ def gods_2hop(rep: Report) -> None:
         two()
         lat.append(time.time() - t)
     g.close()
+    # detail ONLY — the report's metric line belongs to the headline BFS
+    # stage (VERDICT r5 weak #1: the old rep.headline call here
+    # overwrote the scale-26 TEPS record in the driver artifact)
     rep.detail["gods_2hop_p50_ms"] = round(sorted(lat)[len(lat) // 2] * 1e3,
                                            3)
     rep.detail["gods_2hop_count"] = int(count)
-    rep.headline("gods_2hop_p50_ms", rep.detail["gods_2hop_p50_ms"], "ms",
-                 0.0)
     rep.emit()
 
 
@@ -708,9 +776,14 @@ def main() -> None:
         ("gods_2hop", lambda: gods_2hop(rep)),
         ("ldbc", (lambda: ldbc_is3_4hop(rep)) if on_accel else
          (lambda: ldbc_is3_4hop(rep, n_persons=1000, avg_degree=10))),
-        ("ssspwcc", lambda: sssp_wcc(rep, headline_scale)),
+        # store_ingest AHEAD of ssspwcc (VERDICT r5 #2: it is the
+        # north-star store->CSR contract and has gone uncaptured for two
+        # rounds; SSSP/WCC are "measure" rows and share the resident
+        # s26 upload either way)
         ("store_ingest", lambda: store_ingest_stage(
-            rep, 22 if on_accel else min(headline_scale, 14))),
+            rep, 22 if on_accel else min(headline_scale, 14),
+            smoke=not on_accel)),
+        ("ssspwcc", lambda: sssp_wcc(rep, headline_scale)),
         ("bfs_heavy", lambda: bfs_heavy_stage(rep)),
         # the sharded-overhead stage also times the plain hybrid at the
         # warm scale, so it outranks the standalone warm stage when the
@@ -725,7 +798,18 @@ def main() -> None:
                   if s[0] not in ("bfs23", "bfs23_sharded")]
 
     for name, fn in stages:
-        est = _EST.get(name, 60)
+        # estimates re-price against the MEASURED tunnel rate (the
+        # headline stage's own upload observes it — VERDICT r5 weak #2:
+        # flat fast-day numbers admitted bfs_heavy into the driver kill)
+        est = _est(name, on_accel)
+        # stages with IN-STAGE fallbacks are admitted at their cheapest
+        # fallback cost — pricing them at full cost here would make the
+        # fallback paths unreachable (the stage itself then right-sizes
+        # scale/reps against _left())
+        if name == "store_ingest":
+            est = est / 4 + 60      # two scale steps down (~halves/step)
+        elif name == "bfs_heavy":
+            est = max(est - 60, est / 2)   # reps 2 -> 1
         if not on_accel and headline_scale < 20:
             # CI/smoke scales: the table's estimates assume bench-scale
             # graphs; a small-scale CPU run costs ~1/10th. On an
@@ -737,9 +821,13 @@ def main() -> None:
         # the HEADLINE stage is never budget-skipped: a report without
         # the headline metric is worthless however honest the skip note
         # (it runs first, so this only matters for sub-estimate smoke
-        # budgets)
-        if name != HEADLINE_STAGE and _left() < est:
-            rep.skip(name, f"budget: {_left():.0f}s left < est {est}s")
+        # budgets). Everything else also respects a hard reserve before
+        # the observed external window — nothing new starts that could
+        # ride into the driver kill (rc=124 three rounds running).
+        if name != HEADLINE_STAGE and _left() < est + _HARD_RESERVE_S:
+            rep.skip(name, f"budget: {_left():.0f}s left < est "
+                           f"{est:.0f}s + {_HARD_RESERVE_S:.0f}s reserve "
+                           f"(h2d {_h2d_gbps:.3f}GB/s)")
             continue
         try:
             fn()

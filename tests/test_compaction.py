@@ -183,14 +183,24 @@ def test_round_loop_modules_are_nonzero_free():
     must not call it AT ALL; every compaction goes through
     ops.compaction. (bfs.py / bfs_hybrid_fused.py keep theirs: the plain
     reference model and the single-dispatch fused experiment are not
-    round-loop hot paths.)"""
+    round-loop hot paths.) The ban extends to the serving layer
+    (ISSUE r7): its batched [K, n] round loops — and any future kernel
+    code under olap/serving/ — must use the compaction primitives too."""
+    import importlib
     import inspect
     import io
+    import pkgutil
     import tokenize
 
+    import titan_tpu.olap.serving as serving_pkg
     from titan_tpu.models import bfs_hybrid, bfs_hybrid_sharded, frontier
 
-    for mod in (frontier, bfs_hybrid, bfs_hybrid_sharded):
+    serving_mods = [
+        importlib.import_module(f"titan_tpu.olap.serving.{m.name}")
+        for m in pkgutil.iter_modules(serving_pkg.__path__)]
+    assert len(serving_mods) >= 5   # jobs/pool/hbm/batcher/scheduler
+
+    for mod in (frontier, bfs_hybrid, bfs_hybrid_sharded, *serving_mods):
         src = inspect.getsource(mod)
         calls = [
             (tok.start[0], line)

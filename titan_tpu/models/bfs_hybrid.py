@@ -696,6 +696,279 @@ def _frontier_of():
     return _get("hybrid_frontier_of", build)
 
 
+# --------------------------------------------------------------------------
+# batched multi-source BFS: K concurrent jobs share one device run
+# --------------------------------------------------------------------------
+#
+# The serving layer (olap/serving) fuses K same-snapshot BFS jobs into one
+# batched run with state widened to [K, n+1]: the per-level n-scale plan
+# (candidate compaction + per-job frontier stats) runs ONCE for all K jobs
+# instead of once per job, and every edge-chunk gather from the
+# HBM-resident dstT is read once and tested against all K frontier
+# bitmaps (each n/8 bytes — the cache-resident fast-gather regime). That
+# amortizes the per-round plan floor K-fold (PERF_NOTES "K-way
+# plan-amortization model"). The sweep is bottom-up only (level-
+# synchronous pull over the shared candidate list) — BFS distances are
+# canonical, so dist[k] is bit-equal to a sequential single-source run
+# regardless of direction strategy; per-job direction optimization inside
+# a batch is future work. SYMMETRIC graphs only (module contract above).
+
+
+def _pack_bits_batched(dist, active, level, n_: int):
+    """[K, nbytes] frontier bitmaps: bit v of row k = (dist[k, v] ==
+    level and job k is active). Inactive jobs get an all-zero row, so
+    the hit tests below can never find anything for them — the per-job
+    early-exit/cancellation mask is exactly this zeroing."""
+    import jax.numpy as jnp
+
+    K = dist.shape[0]
+    nbytes = (n_ + 2 + 7) // 8
+    mask = (dist == level) & active[:, None]
+    mask = jnp.concatenate([mask, jnp.zeros((K, 8), bool)], axis=1)
+    return jnp.packbits(mask[:, :nbytes * 8], axis=1, bitorder="little")
+
+
+def _bit_of_batched(fbits, idx):
+    """Test all K bitmaps at shared int32 indices: fbits [K, nbytes],
+    idx [...] -> bool [K, *idx.shape]. One index expression serves every
+    job (the byte gather fans out along the job axis only)."""
+    import jax.numpy as jnp
+
+    w = jnp.take(fbits, idx >> 3, axis=1)
+    return ((w >> (idx & 7).astype(jnp.uint8)) & jnp.uint8(1)) \
+        .astype(bool)
+
+
+def _batched_plan():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("c_cap", "n_"))
+        def bplan(dist, active, level, degc, c_cap: int, n_: int):
+            """ONE n-scale pass serving all K jobs: the per-job frontier
+            counts (early-exit decisions), the SHARED candidate list
+            (vertices unvisited in ANY active job, deg > 0 — one
+            compaction amortized over K), and the per-job frontier
+            bitmaps for the bottom-up hit tests."""
+            fbits = _pack_bits_batched(dist, active, level, n_)
+            unvis = (dist[:, :n_] >= INF) & active[:, None]
+            nf = ((dist[:, :n_] == level) & active[:, None]) \
+                .sum(axis=1).astype(jnp.int32)
+            cand_mask = unvis.any(axis=0) & (degc[:n_] > 0)
+            c_count, cand = compact_ids(cand_mask, c_cap, n_ + 1)
+            return fbits, cand, jnp.concatenate([c_count[None], nf])
+        return bplan
+    return _get("batched_plan", build)
+
+
+def _batched_bu():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("c_cap", "n_", "fuse"),
+                           donate_argnums=(0,))
+        def bstep(dist, fbits, cand, off, prog, level, dstT, colstart,
+                  degc, c_cap: int, n_: int, fuse: int):
+            """``fuse`` chunk-check rounds over the shared candidate
+            list: chunk ``off`` of each candidate is gathered ONCE and
+            tested against all K bitmaps; per-job finds scatter into
+            dist rows; a candidate survives while it has chunks left
+            AND some job still has it undecided."""
+            c_count = prog[0]
+            q_pad = dstT.shape[1] - 1
+
+            def round_(state, _):
+                dist, cand, off, c_count = state
+                alive = jnp.arange(c_cap) < c_count
+                v = jnp.minimum(cand, n_)
+                cols = jnp.where(alive & (off < degc[v]),
+                                 colstart[v] + off, q_pad)
+                parents = jnp.take(dstT, jnp.clip(cols, 0, q_pad),
+                                   axis=1)                 # [8, c_cap]
+                hit = _bit_of_batched(fbits, parents) \
+                    .any(axis=1)                           # [K, c_cap]
+                undec = dist[:, v] >= INF
+                found = undec & hit & alive[None, :]
+                dist = dist.at[:, jnp.where(alive, v, n_ + 1)].min(
+                    jnp.where(found, level + 1, INF), mode="drop")
+                rem = (undec & ~hit).any(axis=0)
+                surv = alive & rem & (off + 1 < degc[v])
+                nc = surv.sum().astype(jnp.int32)
+                _, (cand2, off2) = scatter_compact(
+                    surv, (cand, off + 1), c_cap, (n_ + 1, 0))
+                return (dist, cand2, off2, nc), None
+
+            (dist, cand, off, c_count), _ = jax.lax.scan(
+                round_, (dist, cand, off, c_count), None, length=fuse)
+            alive = jnp.arange(c_cap) < c_count
+            v = jnp.minimum(cand, n_)
+            rem8 = jnp.where(alive, jnp.maximum(degc[v] - off, 0), 0) \
+                .sum(dtype=jnp.int32)
+            return dist, cand, off, jnp.stack([c_count, rem8])
+        return bstep
+    return _get("batched_bu", build)
+
+
+def _batched_exhaust():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("c_cap", "p_cap", "n_"),
+                           donate_argnums=(0,))
+        def bex(dist, fbits, cand, off, prog, level, dstT, colstart,
+                degc, c_cap: int, p_cap: int, n_: int):
+            """One masked sweep over ALL remaining chunks of the
+            surviving candidates (hub stragglers), per-job any-hit via
+            a shared owner scatter."""
+            c_count = prog[0]
+            valid = jnp.arange(c_cap) < c_count
+            v = jnp.minimum(cand, n_)
+            rem = jnp.maximum(degc[v] - off, 0)
+            cols, p_total, owner = enumerate_chunk_pairs(
+                valid, rem, colstart[v] + off, p_cap,
+                dstT.shape[1] - 1, with_owner=True)
+            parents = jnp.take(dstT, cols, axis=1)       # [8, p_cap]
+            hit = _bit_of_batched(fbits, parents) \
+                .any(axis=1)                             # [K, p_cap]
+            j = jnp.arange(p_cap, dtype=jnp.int32)
+            own = jnp.where(j < p_total, owner, c_cap - 1)
+            found_per = jnp.zeros((dist.shape[0], c_cap), jnp.int32) \
+                .at[:, own].max(hit.astype(jnp.int32), mode="drop")
+            undec = dist[:, v] >= INF
+            found = undec & (found_per > 0) & valid[None, :]
+            dist = dist.at[:, jnp.where(valid, v, n_ + 1)].min(
+                jnp.where(found, level + 1, INF), mode="drop")
+            return dist
+        return bex
+    return _get("batched_ex", build)
+
+
+def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
+                         on_level=None, return_device: bool = False):
+    """Batched multi-source BFS: run K BFS jobs over the SAME graph as
+    one device run with [K, n] state. Each job's ``dist`` row is
+    bit-equal to ``frontier_bfs_hybrid`` from that source (BFS distances
+    are canonical); the per-level plan and every edge-chunk gather are
+    shared across jobs.
+
+    ``on_level(level, frontier_counts)``: optional host callback after
+    each level's plan, receiving the per-job frontier sizes (np int32
+    [K]); it may return a boolean KEEP mask [K] — jobs masked out
+    (cancellation, deadline, timeout) stop executing before the level's
+    sweep and report ``completed=False``. Returning None keeps all.
+
+    Returns ``(dist, levels, completed)``: dist [K, n] (device array
+    when ``return_device``, else numpy; INF = unreachable — partial for
+    non-completed jobs), levels np int32 [K] (the level at which each
+    job's frontier emptied), completed np bool [K] (False = deactivated
+    early via on_level)."""
+    import jax.numpy as jnp
+
+    g = snap_or_graph if isinstance(snap_or_graph, dict) \
+        else build_chunked_csr(snap_or_graph)
+    n = g["n"]
+    dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
+    K = len(sources)
+    if K == 0:
+        raise ValueError("frontier_bfs_batched needs >= 1 source")
+    src_arr = np.asarray(sources, np.int64)
+    if len(src_arr) and (src_arr.min() < 0 or src_arr.max() >= n):
+        raise IndexError(f"source out of range [0, {n})")
+    bplan = _batched_plan()
+    bstep = _batched_bu()
+    bex = _batched_exhaust()
+    from titan_tpu.utils.jitcache import dev_scalar
+
+    cap_n = _next_pow2(max(n, 2))
+
+    def pad(a):
+        if a.shape[0] < cap_n:
+            a = jnp.concatenate(
+                [a, jnp.full((cap_n - a.shape[0],), n + 1, a.dtype)])
+        return a
+
+    dist = jnp.full((K, n + 1), INF, jnp.int32) \
+        .at[jnp.arange(K), jnp.asarray(src_arr.astype(np.int32))].set(0)
+    act_h = np.ones(K, bool)
+    active = jnp.asarray(act_h)
+    levels = np.zeros(K, np.int32)
+    completed = np.zeros(K, bool)
+    level = 0
+    while level < max_levels:
+        fbits, cand, stats = bplan(dist, active, dev_scalar(level), degc,
+                                   c_cap=cap_n, n_=n)
+        st = np.asarray(stats)          # ONE sync per level for ALL jobs
+        nf = st[1:]
+        mask_changed = False
+        # frontier emptied => that job's BFS is complete
+        newly_done = act_h & (nf == 0)
+        if newly_done.any():
+            completed[newly_done] = True
+            levels[newly_done] = level
+            act_h = act_h & ~newly_done
+            mask_changed = True
+        if on_level is not None and act_h.any():
+            keep = on_level(level, nf.copy())
+            if keep is not None:
+                dropped = act_h & ~np.asarray(keep, bool)
+                if dropped.any():
+                    levels[dropped] = level
+                    act_h = act_h & ~dropped
+                    mask_changed = True
+        if not act_h.any():
+            break
+        if mask_changed:
+            # deactivated jobs (completed OR dropped) must stop
+            # influencing the sweep: re-plan with the new mask — it
+            # zeroes their bitmap rows AND drops their unvisited sets
+            # from the shared candidate list (a completed small-
+            # component job would otherwise re-contribute ~n dead
+            # candidates to every remaining level)
+            active = jnp.asarray(act_h)
+            fbits, cand, stats = bplan(dist, active, dev_scalar(level),
+                                       degc, c_cap=cap_n, n_=n)
+            st = np.asarray(stats)
+        c_count = int(st[0])
+        # chunk rounds over the shared candidate list (bu_more shape)
+        off = None
+        rounds = 0
+        prog = None
+        while c_count > 0 and rounds < BU_CHUNK_ROUNDS:
+            c_cap2 = min(_next_pow2(max(c_count, 2)), cap_n)
+            if off is None:
+                cand = pad(cand)
+                off = jnp.zeros((cap_n,), jnp.int32)
+                prog = jnp.asarray([c_count, 0], jnp.int32)
+            fuse = BU_CHUNK_ROUNDS - rounds
+            dist, cand, off, prog = bstep(
+                dist, fbits, cand[:c_cap2], off[:c_cap2], prog,
+                dev_scalar(level), dstT, colstart, degc,
+                c_cap=c_cap2, n_=n, fuse=fuse)
+            cand, off = pad(cand), pad(off)
+            c_count, rem8 = (int(x) for x in np.asarray(prog))
+            rounds += fuse
+        if c_count > 0:
+            c_cap2 = min(_next_pow2(max(c_count, 2)), cap_n)
+            rem_cap = _next_pow2(max(rem8, 2))
+            dist = bex(dist, fbits, cand[:c_cap2], off[:c_cap2], prog,
+                       dev_scalar(level), dstT, colstart, degc,
+                       c_cap=c_cap2, p_cap=rem_cap, n_=n)
+        level += 1
+    # jobs still active at max_levels count as completed-at-cap
+    if act_h.any():
+        completed[act_h] = True
+        levels[act_h] = level
+    out = dist[:, :n]
+    if not return_device:
+        out = np.asarray(out)
+    return out, levels, completed
+
+
 def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
                         return_device: bool = False):
     """Direction-optimizing BFS. Returns (dist, levels); ``dist`` is a

@@ -300,9 +300,21 @@ def _max_degc(g) -> int:
 QUANTILE_MASS_DEFAULT = 1 << 24
 
 
+class RoundInterrupted(Exception):
+    """Raised out of ``_frontier_run`` when the caller's ``on_round``
+    callback vetoes continuing — the serving layer's cancellation /
+    timeout path for single-execution SSSP/WCC jobs (olap/serving
+    drops the job at a round boundary instead of abandoning the whole
+    process; the device state simply stops being advanced)."""
+
+    def __init__(self, rounds: int):
+        super().__init__(f"interrupted after {rounds} rounds")
+        self.rounds = rounds
+
+
 def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
                   max_rounds: int, delta: float | None = None,
-                  quantile_mass: int = 0):
+                  quantile_mass: int = 0, on_round=None):
     """Expansion-tracked round loop: one plan readback per round
     (_band_plan — compacted in-band list + mass-balanced segment
     bounds, no n-wide nonzero), then one _push_list dispatch per
@@ -361,6 +373,11 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
     # mass-accounting trace consumers must not pay
     drain = trace is not None and g.get("_trace_plan_drain")
     while rounds < max_rounds:
+        # serving-layer veto (cancellation/timeout) at the round
+        # boundary — same per-job early-exit discipline as the batched
+        # BFS level mask, for the single-execution kinds
+        if on_round is not None and not on_round(rounds):
+            raise RoundInterrupted(rounds)
         # list width: quantile mode caps at QUANT_LIST_CAP (the band
         # carries ~quantile_mass chunks, so members are bounded and
         # truncation only defers); plain/delta modes must cover EVERY
@@ -451,7 +468,7 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
                   w_range: float = 1.0, max_rounds: int = 10_000,
                   delta: float | None = None,
                   quantile_mass: int | None = None,
-                  return_device: bool = False):
+                  return_device: bool = False, on_round=None):
     """SSSP over hashed edge weights with an expansion-tracked frontier;
     ``delta`` > 0 adds delta-stepping buckets. Returns (dist float32 [n]
     with FINF unreachable, rounds).
@@ -485,7 +502,8 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
     val_exp = jnp.full((n + 1,), FINF, jnp.float32)
     out, rounds = _frontier_run(g, val, val_exp, "sssp",
                                 (min_w, w_range), max_rounds,
-                                delta=delta, quantile_mass=quantile_mass)
+                                delta=delta, quantile_mass=quantile_mass,
+                                on_round=on_round)
     if not return_device:
         out = np.asarray(out)
     return out, rounds
@@ -517,12 +535,15 @@ def _wcc_seed_labels():
 
 def pagerank_dense(snap_or_graph, iterations: int = 20,
                    damping: float = 0.85, tol: float | None = None,
-                   return_device: bool = False):
+                   return_device: bool = False, on_round=None):
     """Push-mode PageRank over the chunked CSR via dense window sweeps:
     rank' = (1-d)/n + d * sum over in-edges of rank[src]/outdeg[src]
     (semantics match the pull-mode engine program in models/pagerank.py,
     incl. leaking dangling mass). Returns (rank float32 [n], iterations
-    run). ``tol``: early exit when the L1 delta falls below it."""
+    run). ``tol``: early exit when the L1 delta falls below it.
+    ``on_round``: per-iteration veto (RoundInterrupted) — the serving
+    layer's cancellation/timeout hook, same contract as
+    ``_frontier_run``."""
     import jax.numpy as jnp
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
@@ -540,6 +561,8 @@ def pagerank_dense(snap_or_graph, iterations: int = 20,
     contrib = jnp.where(deg > 0, rank / jnp.maximum(deg, 1.0), 0.0)
     it = 0
     for it in range(1, iterations + 1):
+        if on_round is not None and not on_round(it - 1):
+            raise RoundInterrupted(it - 1)
         acc = jnp.zeros((n + 1,), jnp.float32)
         for w0 in range(0, total, W):
             # pooled window starts: a fresh scalar put per window costs
@@ -595,7 +618,7 @@ def _pr_finish():
 
 
 def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
-                 return_device: bool = False):
+                 return_device: bool = False, on_round=None):
     """Hybrid connected components (symmetrized graphs): peel the seed
     vertex's whole component with one direction-optimized BFS, then run
     min-label propagation over the remaining components only. Returns
@@ -620,7 +643,7 @@ def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
     # nothing — it only reads [:n_]
     val, val_exp = _wcc_seed_labels()(dist, n_=n)
     out, rounds = _frontier_run(g, val, val_exp, "wcc", (0.0, 0.0),
-                                max_rounds)
+                                max_rounds, on_round=on_round)
     if not return_device:
         out = np.asarray(out)
     return out, rounds + levels

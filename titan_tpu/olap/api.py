@@ -248,6 +248,39 @@ class EdgeData:
     values: dict = field(default_factory=dict)   # name -> np/jnp array [E]
 
 
+@dataclass
+class JobSpec:
+    """Declarative vertex-program job for the async serving layer
+    (olap/serving — the rebuild of the reference's L7→L4b seam where
+    gremlin-server requests feed FulgoraGraphComputer's executor, here
+    as an admission-controlled queue over the TPU engine).
+
+    ``kind``: 'bfs' (batchable — same-snapshot BFS jobs fuse into ONE
+    [K, n] multi-source device run), 'sssp' | 'pagerank' | 'wcc'
+    (frontier kernels, executed singly), 'dense' (a DenseProgram
+    instance under ``params['program']``), or 'callable'
+    (``params['fn']`` — the host computer's async delegation hook).
+
+    ``deadline`` is an absolute ``time.time()`` by which the job must
+    START — jobs still queued past it are EXPIRED by admission control.
+    ``timeout_s`` bounds RUNTIME; for batched BFS it is enforced at
+    level boundaries through the per-job early-exit mask.
+    ``labels``/``edge_keys``/``directed`` select the snapshot the job
+    runs against (SnapshotPool parameters; ``directed=False``
+    symmetrizes, which the direction-optimizing BFS kernels require).
+    For 'dense' jobs the scheduler derives ``edge_keys`` from the
+    program's ``edge_keys()`` when unset."""
+
+    kind: str
+    params: dict = field(default_factory=dict)
+    priority: int = 0
+    deadline: Optional[float] = None
+    timeout_s: Optional[float] = None
+    labels: Optional[Sequence[str]] = None
+    edge_keys: Sequence[str] = ()
+    directed: bool = False
+
+
 class DenseProgram(abc.ABC):
     """TPU-native vertex program: one compiled superstep, iterated on device.
 

@@ -141,6 +141,22 @@ class HostGraphComputer:
         import os
         self.num_threads = num_threads or min(32, (os.cpu_count() or 4))
 
+    def run_async(self, program: VertexProgram, scheduler,
+                  max_iterations: int = 100, write_back: bool = False,
+                  map_reduces: Optional[list] = None):
+        """Delegate a host BSP run to the serving scheduler: the job
+        queues behind (and shares admission with) the TPU jobs, and its
+        result is this computer's HostComputerResult. Returns the Job
+        handle immediately."""
+        from titan_tpu.olap.api import JobSpec
+
+        def _run():
+            return self.run(program, max_iterations=max_iterations,
+                            write_back=write_back,
+                            map_reduces=map_reduces)
+        return scheduler.submit(JobSpec(kind="callable",
+                                        params={"fn": _run}))
+
     def run(self, program: VertexProgram, max_iterations: int = 100,
             write_back: bool = False,
             map_reduces: Optional[list] = None) -> HostComputerResult:

@@ -1,0 +1,28 @@
+"""OLAP serving layer: concurrent job scheduling + multi-source batching.
+
+This package rebuilds the reference's L7→L4b serving seam — gremlin-server
+YAML endpoints feeding ``FulgoraGraphComputer``'s executor service
+(reference: titan-dist conf/gremlin-server/gremlin-server.yaml +
+graphdb/olap/computer/FulgoraGraphComputer.java:48-120) — as an
+admission-controlled asynchronous job plane over the TPU engine:
+
+* ``jobs``      — job/handle lifecycle (queued → running → terminal).
+* ``pool``      — epoch-aware snapshot pool: concurrent jobs share one
+                  ``GraphSnapshot`` per parameter set, refreshed through
+                  the epoch/refresh() freshness contract before hand-out.
+* ``hbm``       — device-memory accounting (the bench ``_DEV_GRAPHS``
+                  budget/eviction logic as a library) backing admission.
+* ``batcher``   — multi-source fusion: compatible same-snapshot BFS jobs
+                  execute as ONE batched [K, n] device run
+                  (models/bfs_hybrid.frontier_bfs_batched), amortizing
+                  the per-level plan floor K-fold.
+* ``scheduler`` — priority queue + admission + worker, with per-job
+                  latency / queue-depth / batch-occupancy metrics
+                  through utils/metrics.
+
+``server.py`` exposes this as ``POST /jobs`` / ``GET /jobs/<id>`` /
+``DELETE /jobs/<id>``; docs/serving.md documents the contract.
+"""
+
+from titan_tpu.olap.serving.jobs import Job, JobState            # noqa: F401
+from titan_tpu.olap.serving.scheduler import JobScheduler        # noqa: F401

@@ -1,0 +1,232 @@
+"""Multi-source fusion: execute compatible jobs as one batched device run.
+
+The batcher is the execution half of the serving layer: given a group of
+admitted jobs leased onto ONE snapshot, it
+
+* fuses BFS jobs into a single ``[K, n]`` multi-source run
+  (models/bfs_hybrid.frontier_bfs_batched) — the per-level plan and
+  every edge-chunk gather are shared across the K jobs, amortizing the
+  per-round plan floor K-fold (PERF_NOTES "K-way plan-amortization
+  model"). Cancellation and timeout act through the kernel's per-job
+  early-exit mask at level boundaries;
+* runs everything else singly (sssp / pagerank / wcc frontier kernels,
+  'dense' DensePrograms through the TPU engine, 'callable' host
+  delegations), honoring cancel-before-start.
+
+Results are plain dicts; the full distance arrays stay host-side under
+keys the wire form omits (Job.to_wire) — callers resolve per-target
+distances via ``params['targets']``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from titan_tpu.olap.serving.jobs import Job
+
+#: jobs of these kinds fuse into one batched run when they share a
+#: snapshot (the only batchable kind today; SSSP banding is next)
+BATCHABLE_KINDS = ("bfs",)
+
+
+def batch_key(spec) -> Optional[tuple]:
+    """Grouping key: jobs with equal keys may fuse into one batch.
+    ``max_levels`` is part of the key — the batched kernel runs ONE
+    shared level loop, so a job with a tighter level cap must not drag
+    batchmates down to it (nor ride past its own)."""
+    if spec.kind not in BATCHABLE_KINDS:
+        return None
+    try:
+        max_levels = int(spec.params.get("max_levels", 1000))
+    except (TypeError, ValueError):
+        return None      # junk max_levels: run (and fail) alone
+    return (spec.kind,
+            tuple(spec.labels) if spec.labels is not None else None,
+            bool(spec.directed),
+            max_levels)
+
+
+def _dense_source(snap, params: dict) -> int:
+    """Resolve a job's source to a dense index: ``source_dense`` wins,
+    else ``source`` is an original vertex id mapped through the
+    snapshot. Raises ValueError for ANY malformed value (None, lists,
+    non-numeric strings) — callers catch it per job; it must never
+    escape as a TypeError that could take the worker thread down."""
+    try:
+        if "source_dense" in params:
+            return int(params["source_dense"])
+        if "source" in params:
+            return snap.dense_of(int(params["source"]))
+    except KeyError as e:                 # dense_of: unknown vertex
+        raise ValueError(str(e)) from e
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"bad source value: {e}") from e
+    raise ValueError("job params need 'source' (vertex id) or "
+                     "'source_dense'")
+
+
+def _bfs_result(snap, dist_row: np.ndarray, levels: int, inf: int,
+                params: dict) -> dict:
+    reached = int((dist_row < inf).sum())
+    out = {"levels": int(levels), "reached": reached, "n": int(dist_row.shape[0]),
+           "dist": dist_row}
+    targets = params.get("targets")
+    if targets:
+        td = {}
+        for t in targets:
+            try:
+                d = int(dist_row[snap.dense_of(int(t))])
+            except Exception:     # unknown vertex / malformed value —
+                d = None          # a bad target is None, never a crash
+            td[str(t)] = d if d is not None and d < inf else None
+        out["targets"] = td
+    return out
+
+
+class Batcher:
+    """Stateless executor over leased snapshots (the scheduler owns the
+    queue, admission and leases)."""
+
+    def __init__(self, max_batch: int = 16):
+        self.max_batch = max_batch
+
+    # -- batched BFS --------------------------------------------------------
+
+    def run_bfs_batch(self, jobs: list[Job], snap) -> None:
+        """Execute K BFS jobs as one batched [K, n] device run; each
+        job's row is bit-equal to a sequential single-source run. Jobs
+        whose source does not resolve fail up front (they never join the
+        batch); cancellation/timeout drop individual jobs at level
+        boundaries via the kernel's keep mask."""
+        from titan_tpu.models.bfs import INF
+        from titan_tpu.models.bfs_hybrid import frontier_bfs_batched
+
+        runnable: list[Job] = []
+        sources: list[int] = []
+        for job in jobs:
+            try:
+                sources.append(_dense_source(snap, job.spec.params))
+                runnable.append(job)
+            except (KeyError, ValueError) as e:
+                job.fail(f"{type(e).__name__}: {e}")
+        if not runnable:
+            return
+        K = len(runnable)
+        for job in runnable:
+            job.batch_k = K
+        started = time.time()
+        dropped = [None] * K    # terminal state decided at a boundary
+
+        def on_level(level, nf):
+            keep = np.ones(K, bool)
+            now = time.time()
+            for i, job in enumerate(runnable):
+                if dropped[i] is not None:
+                    keep[i] = False
+                    continue
+                if job.cancel_requested:
+                    dropped[i] = "cancel"
+                    keep[i] = False
+                elif job.spec.timeout_s is not None and \
+                        now - started > job.spec.timeout_s:
+                    dropped[i] = "timeout"
+                    keep[i] = False
+            return keep if not keep.all() else None
+
+        try:
+            dist, levels, completed = frontier_bfs_batched(
+                snap, sources, max_levels=int(
+                    runnable[0].spec.params.get("max_levels", 1000)),
+                on_level=on_level)
+        except Exception as e:
+            for job in runnable:
+                job.fail(f"{type(e).__name__}: {e}")
+            return
+        inf = int(INF)
+        for i, job in enumerate(runnable):
+            if completed[i]:
+                job.complete(_bfs_result(snap, dist[i], levels[i], inf,
+                                         job.spec.params))
+            elif dropped[i] == "timeout":
+                job.time_out()
+            else:
+                job.mark_cancelled()
+
+    # -- single execution ---------------------------------------------------
+
+    def run_single(self, job: Job, snap) -> None:
+        """One job alone (still async from the caller's view). The
+        frontier kinds honor cancellation/timeout at ROUND boundaries
+        through ``_frontier_run``'s on_round veto (models/frontier
+        RoundInterrupted) — the single-execution analog of the batched
+        kernel's level mask."""
+        job.batch_k = 1
+        kind = job.spec.kind
+        params = dict(job.spec.params)
+        started = time.time()
+        interrupted = {}
+
+        def on_round(rounds):
+            if job.cancel_requested:
+                interrupted["why"] = "cancel"
+                return False
+            if job.spec.timeout_s is not None and \
+                    time.time() - started > job.spec.timeout_s:
+                interrupted["why"] = "timeout"
+                return False
+            return True
+
+        try:
+            if kind == "bfs":
+                self.run_bfs_batch([job], snap)
+                return
+            if kind == "sssp":
+                from titan_tpu.models.frontier import FINF, frontier_sssp
+                src = _dense_source(snap, params)
+                dist, rounds = frontier_sssp(
+                    snap, src,
+                    delta=params.get("delta"),
+                    quantile_mass=params.get("quantile_mass"),
+                    max_rounds=int(params.get("max_rounds", 10_000)),
+                    on_round=on_round)
+                dist = np.asarray(dist)
+                job.complete({"rounds": int(rounds),
+                              "reached": int((dist < float(FINF)).sum()),
+                              "dist": dist})
+            elif kind == "pagerank":
+                from titan_tpu.models.frontier import pagerank_dense
+                rank, iters = pagerank_dense(
+                    snap, iterations=int(params.get("iterations", 20)),
+                    damping=float(params.get("damping", 0.85)),
+                    tol=params.get("tol"), on_round=on_round)
+                job.complete({"iterations": int(iters),
+                              "rank": np.asarray(rank)})
+            elif kind == "wcc":
+                from titan_tpu.models.frontier import frontier_wcc
+                lab, rounds = frontier_wcc(snap, on_round=on_round)
+                lab = np.asarray(lab)
+                job.complete({"rounds": int(rounds),
+                              "components": int(len(np.unique(lab))),
+                              "labels": lab})
+            elif kind == "dense":
+                from titan_tpu.olap.tpu.engine import run_single
+                program = params.pop("program")
+                res = run_single(program, snap, params)
+                job.complete({"iterations": res.iterations,
+                              **{k: np.asarray(v) for k, v in res.items()}})
+            elif kind == "callable":
+                job.complete({"value": params["fn"]()})
+            else:
+                job.fail(f"unknown job kind {kind!r}")
+        except Exception as e:
+            from titan_tpu.models.frontier import RoundInterrupted
+            if isinstance(e, RoundInterrupted):
+                if interrupted.get("why") == "timeout":
+                    job.time_out()
+                else:
+                    job.mark_cancelled()
+            else:
+                job.fail(f"{type(e).__name__}: {e}")

@@ -1,0 +1,149 @@
+"""Device-memory (HBM) accounting for graph images — admission's ledger.
+
+This is the bench driver's ``_DEV_GRAPHS`` budget logic promoted to a
+library (ISSUE r7: "as a library, not a script-local"): the serving
+scheduler admits jobs against it before building a snapshot's chunked
+CSR on device, and bench.py's stage-shared graph cache is the same
+``DeviceGraphCache`` re-used. The byte model matches what the kernels
+actually upload: the transposed 8-aligned ``dstT`` [8, q_total] int32
+plus three [n+1] int32 side arrays (colstart/degc/deg) —
+models/bfs_hybrid.build_chunked_csr's exact footprint. Eviction is
+largest-first over unpinned entries (the bench policy); pinned entries
+(graphs under a running batch) are never evicted.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+#: default budget, bench.py's historical 12 GB of a 16 GB v5e HBM
+#: (leaving headroom for kernel state/temporaries)
+DEFAULT_BUDGET_BYTES = 12.0e9
+
+
+def chunked_csr_bytes(n: int, q_total: int) -> int:
+    """Device bytes of a chunked CSR: dstT [8, q_total] int32 + 3 x
+    [n+1] int32 (colstart/degc/deg)."""
+    return q_total * 8 * 4 + 3 * 4 * (n + 1)
+
+
+def graph_bytes(hg: dict) -> int:
+    """Bytes for a host-graph dict (graph500.load_or_build result)."""
+    return chunked_csr_bytes(hg["n"], hg["q_total"])
+
+
+def snapshot_csr_bytes(snap) -> int:
+    """Predicted device bytes for a GraphSnapshot's chunked CSR,
+    computable BEFORE the build (admission must not pay the upload to
+    learn it doesn't fit): q_total = sum(ceil(deg/8)) + 1 pad column."""
+    deg = snap.out_degree
+    q_total = int((-(-deg.astype("int64") // 8)).sum()) + 1
+    return chunked_csr_bytes(snap.n, q_total)
+
+
+class AdmissionError(RuntimeError):
+    """The job's graph image cannot fit the HBM budget even after
+    evicting every unpinned resident graph."""
+
+
+class HBMLedger:
+    """Budgeted accounting of device-resident graph images.
+
+    ``reserve(key, nbytes)`` charges an entry, evicting largest-first
+    among unpinned entries until it fits (``on_evict(key)`` lets the
+    owner drop the device arrays — actual frees happen when the last
+    jax reference dies). Raises AdmissionError when even a full sweep
+    cannot make room. Entries are pinned while reserved; ``unpin``
+    leaves them resident-but-evictable (the warm-cache state),
+    ``release`` drops them entirely."""
+
+    def __init__(self, budget_bytes: float = DEFAULT_BUDGET_BYTES,
+                 on_evict: Optional[Callable[[object], None]] = None):
+        self.budget_bytes = float(budget_bytes)
+        self._on_evict = on_evict
+        self._bytes: dict = {}
+        self._pins: dict = {}
+        self._lock = threading.Lock()
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(self._bytes.values())
+
+    def reserve(self, key, nbytes: int) -> None:
+        evicted = []
+        with self._lock:
+            if key in self._bytes:
+                self._pins[key] = self._pins.get(key, 0) + 1
+                return
+            pinned = sum(self._bytes[k] for k, c in self._pins.items()
+                         if c > 0)
+            if pinned + nbytes > self.budget_bytes:
+                raise AdmissionError(
+                    f"admission: graph image needs {nbytes/1e9:.2f}GB "
+                    f"but only {max(self.budget_bytes - pinned, 0)/1e9:.2f}"
+                    f"GB of the {self.budget_bytes/1e9:.2f}GB HBM budget "
+                    "is free of pinned (in-use) graphs")
+            # evict largest unpinned until the new entry fits
+            while sum(self._bytes.values()) + nbytes > self.budget_bytes:
+                victims = {k: b for k, b in self._bytes.items()
+                           if self._pins.get(k, 0) == 0}
+                if not victims:
+                    raise AdmissionError(
+                        "admission: HBM budget exhausted by pinned "
+                        "graphs")
+                victim = max(victims, key=victims.get)
+                self._bytes.pop(victim)
+                self._pins.pop(victim, None)
+                evicted.append(victim)
+            self._bytes[key] = int(nbytes)
+            self._pins[key] = 1
+        for k in evicted:
+            if self._on_evict is not None:
+                self._on_evict(k)
+
+    def unpin(self, key) -> None:
+        with self._lock:
+            if key in self._pins and self._pins[key] > 0:
+                self._pins[key] -= 1
+
+    def release(self, key) -> None:
+        with self._lock:
+            self._bytes.pop(key, None)
+            self._pins.pop(key, None)
+
+
+class DeviceGraphCache:
+    """Stage-shared device-graph cache (bench.py's ``_DEV_GRAPHS`` as a
+    class): ``get_or_load(key, host_loader, uploader)`` returns
+    ``(host_graph, device_graph, gen_s, upload_s)``, keeping every
+    loaded graph resident and evicting largest-first only when a new
+    graph would overflow the budget."""
+
+    def __init__(self, budget_bytes: float = DEFAULT_BUDGET_BYTES):
+        self._ledger = HBMLedger(budget_bytes, on_evict=self._drop)
+        self._graphs: dict = {}
+        self._lock = threading.Lock()
+
+    def __contains__(self, key) -> bool:
+        return key in self._graphs
+
+    def _drop(self, key) -> None:
+        self._graphs.pop(key, None)
+
+    def get_or_load(self, key, host_loader, uploader):
+        import time as _time
+        with self._lock:
+            got = self._graphs.get(key)
+            if got is not None:
+                return got + (0.0, 0.0)
+            t0 = _time.time()
+            hg = host_loader()
+            gen_s = _time.time() - t0
+            self._ledger.reserve(key, graph_bytes(hg))
+            self._ledger.unpin(key)   # resident-but-evictable
+            t0 = _time.time()
+            g = uploader(hg)
+            upload_s = _time.time() - t0
+            self._graphs[key] = (hg, g)
+            return hg, g, gen_s, upload_s

@@ -1,0 +1,185 @@
+"""Job lifecycle for the OLAP serving layer.
+
+A ``Job`` is the handle the scheduler returns at submit time and the
+server serializes over the wire: spec + state machine + result/error +
+timing fields. States:
+
+    QUEUED ──► RUNNING ──► DONE
+       │          │    ├──► FAILED      (exception, admission rejection)
+       │          │    ├──► TIMEOUT     (ran past spec.timeout_s)
+       │          └────┴──► CANCELLED   (DELETE while running — the
+       │                                 batched kernel drops the job at
+       │                                 the next level boundary)
+       ├──► CANCELLED                   (DELETE while queued)
+       └──► EXPIRED                     (spec.deadline passed before start)
+
+Terminal transitions are idempotent-guarded under a lock (a cancel
+racing completion keeps whichever landed first) and release ``wait()``.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+from typing import Any, Optional
+
+from titan_tpu.olap.api import JobSpec
+
+
+class JobState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    FAILED = "failed"
+    TIMEOUT = "timeout"
+    CANCELLED = "cancelled"
+    EXPIRED = "expired"
+
+    @property
+    def terminal(self) -> bool:
+        return self not in (JobState.QUEUED, JobState.RUNNING)
+
+
+_ids = itertools.count(1)
+
+
+class Job:
+    """Scheduler-owned job handle. ``result`` is a dict (kind-specific;
+    large arrays stay host-side under keys the wire form omits);
+    ``batch_k`` records the occupancy of the batch the job ran in (1 for
+    single execution) — the amortization evidence per job."""
+
+    def __init__(self, spec: JobSpec):
+        self.id = f"job-{next(_ids)}"
+        self.spec = spec
+        self.state = JobState.QUEUED
+        self.result: Optional[dict] = None
+        self.error: Optional[str] = None
+        self.batch_k: int = 0
+        self.submitted_at = time.time()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._cancel = threading.Event()
+        self._metered = False
+
+    def metered_once(self) -> bool:
+        """True exactly once — the scheduler's guard so a job's terminal
+        metrics (state counter + latency sample) are recorded a single
+        time even when two paths race to finalize it (e.g. a client
+        cancel landing between queue pop and batch start)."""
+        with self._lock:
+            if self._metered:
+                return False
+            self._metered = True
+            return True
+
+    # -- state machine ------------------------------------------------------
+
+    def _finish(self, state: JobState, *, result: Optional[dict] = None,
+                error: Optional[str] = None) -> bool:
+        """Terminal transition; returns False if already terminal."""
+        with self._lock:
+            if self.state.terminal:
+                return False
+            self.state = state
+            self.result = result
+            self.error = error
+            self.finished_at = time.time()
+        self._done.set()
+        return True
+
+    def start(self) -> bool:
+        """QUEUED → RUNNING (False if the job went terminal first)."""
+        with self._lock:
+            if self.state is not JobState.QUEUED:
+                return False
+            self.state = JobState.RUNNING
+            self.started_at = time.time()
+        return True
+
+    def complete(self, result: dict) -> bool:
+        return self._finish(JobState.DONE, result=result)
+
+    def fail(self, error: str) -> bool:
+        return self._finish(JobState.FAILED, error=error)
+
+    def expire(self) -> bool:
+        return self._finish(JobState.EXPIRED, error="deadline passed "
+                            "before the job started")
+
+    def time_out(self) -> bool:
+        return self._finish(JobState.TIMEOUT,
+                            error=f"exceeded timeout_s="
+                                  f"{self.spec.timeout_s}")
+
+    def cancel(self) -> bool:
+        """Request cancellation. A queued job goes CANCELLED now; a
+        running one is dropped from its batch at the next level boundary
+        (the worker observes ``cancel_requested``). Returns False only
+        when the job already finished in another state."""
+        self._cancel.set()
+        with self._lock:
+            if self.state.terminal:
+                return self.state is JobState.CANCELLED
+            if self.state is JobState.RUNNING:
+                return True   # the worker completes the transition
+            self.state = JobState.CANCELLED
+            self.finished_at = time.time()
+        self._done.set()
+        return True
+
+    def mark_cancelled(self) -> bool:
+        return self._finish(JobState.CANCELLED)
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel.is_set()
+
+    # -- observation --------------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until terminal; True if it finished within timeout."""
+        return self._done.wait(timeout)
+
+    def queue_seconds(self) -> Optional[float]:
+        if self.started_at is None:
+            return None
+        return self.started_at - self.submitted_at
+
+    def exec_seconds(self) -> Optional[float]:
+        if self.started_at is None or self.finished_at is None:
+            return None
+        return self.finished_at - self.started_at
+
+    def to_wire(self) -> dict:
+        """JSON-safe summary (large result arrays omitted)."""
+        out: dict[str, Any] = {
+            "job": self.id,
+            "kind": self.spec.kind,
+            "status": self.state.value,
+            "priority": self.spec.priority,
+            "submitted_at": self.submitted_at,
+            "started_at": self.started_at,
+            "finished_at": self.finished_at,
+            "batch_k": self.batch_k,
+        }
+        q, e = self.queue_seconds(), self.exec_seconds()
+        if q is not None:
+            out["queue_ms"] = round(q * 1e3, 3)
+        if e is not None:
+            out["exec_ms"] = round(e * 1e3, 3)
+        if self.error is not None:
+            out["error"] = self.error
+        if self.result is not None:
+            out["result"] = {
+                k: v for k, v in self.result.items()
+                if isinstance(v, (int, float, str, bool, list, dict))
+                or v is None}
+        return out
+
+    def __repr__(self) -> str:
+        return f"<Job {self.id} {self.spec.kind} {self.state.value}>"

@@ -1,0 +1,198 @@
+"""Epoch-aware snapshot pool: jobs share snapshots, never stale ones.
+
+One ``GraphSnapshot`` per (labels, edge_keys, directed) parameter set is
+shared by every concurrent job, leased out under the snapshot
+epoch/refresh() freshness contract (olap/tpu/snapshot.py):
+
+* fresh → lease it directly;
+* stale with NO active leases → ``refresh()`` in place (the delta-apply
+  path — no store re-scan); a refresh that raises (delta gap racing
+  build()'s scan, listener overflow, extracted edge_values) falls back
+  to a full rebuild — the same retry discipline as build()'s
+  epoch-verified scan;
+* stale with active leases → the leased object's arrays must NOT mutate
+  under a live device run, so the pool builds a REPLACEMENT snapshot and
+  retires the old one (closed when its last lease is released).
+
+The hand-out guarantee (pinned by tests/test_serving_pool.py): the
+snapshot returned by ``acquire()`` has ``epoch >= graph.mutation_epoch``
+as sampled at the call's entry — a new job can never observe pre-acquire
+commits missing from its snapshot, no matter how writers race the
+refresh.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+
+class Lease:
+    """Context-managed snapshot lease; ``release()`` (or ``with``) must
+    run exactly once."""
+
+    __slots__ = ("snapshot", "_release", "_done")
+
+    def __init__(self, snapshot, release):
+        self.snapshot = snapshot
+        self._release = release
+        self._done = False
+
+    def release(self) -> None:
+        if not self._done:
+            self._done = True
+            self._release(self.snapshot)
+
+    def __enter__(self):
+        return self.snapshot
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class SnapshotPool:
+    """See module doc. ``graph=None, snapshot=...`` pins one fixed
+    snapshot (array-built or externally managed) that is always returned
+    as-is — the epoch machinery needs a source graph."""
+
+    def __init__(self, graph=None, snapshot=None, on_close=None):
+        if graph is None and snapshot is None:
+            raise ValueError("SnapshotPool needs a graph or a snapshot")
+        self.graph = graph
+        self._fixed = snapshot
+        self._entries: dict = {}      # key -> current snapshot
+        self._leases: dict = {}       # id(snap) -> count
+        self._retired: dict = {}      # id(snap) -> snap awaiting close
+        self._keylocks: dict = {}     # key -> builder lock (slow path)
+        self._lock = threading.Lock()
+        self._closed = False
+        # called with each snapshot the pool permanently discards
+        # (retire-close / rebuild-close / pool close) — the scheduler
+        # uses it to drop the snapshot's HBM-ledger entry and device
+        # caches, so dead snapshots don't stay "resident"
+        self.on_close = on_close
+
+    def _close_snap(self, snap) -> None:
+        if self.on_close is not None:
+            try:
+                self.on_close(snap)
+            except Exception:
+                pass
+        snap.close()
+
+    @staticmethod
+    def key_of(labels: Optional[Sequence[str]] = None,
+               edge_keys: Sequence[str] = (),
+               directed: bool = False) -> tuple:
+        return (tuple(labels) if labels is not None else None,
+                tuple(edge_keys), bool(directed))
+
+    # -- lease plumbing -----------------------------------------------------
+
+    def _release(self, snap) -> None:
+        to_close = None
+        with self._lock:
+            sid = id(snap)
+            left = self._leases.get(sid, 1) - 1
+            if left > 0:
+                self._leases[sid] = left
+            else:
+                self._leases.pop(sid, None)
+                to_close = self._retired.pop(sid, None)
+        if to_close is not None:
+            self._close_snap(to_close)
+
+    def _lease_locked(self, snap) -> Lease:
+        self._leases[id(snap)] = self._leases.get(id(snap), 0) + 1
+        return Lease(snap, self._release)
+
+    # -- acquisition --------------------------------------------------------
+
+    def acquire(self, labels: Optional[Sequence[str]] = None,
+                edge_keys: Sequence[str] = (),
+                directed: bool = False) -> Lease:
+        """Lease a snapshot for the given parameters whose epoch covers
+        every commit visible before this call.
+
+        Locking: the pool lock guards only the maps (so ``stats()`` and
+        fast-path acquires never block behind a store scan); the SLOW
+        work — build() / refresh(), minutes at bench scale — runs under
+        a per-key builder lock only. A concurrent fast-path acquire
+        cannot lease a snapshot mid-refresh: its epoch is stamped last,
+        so the snapshot reads as stale until the refresh completes."""
+        if self._fixed is not None:
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("pool is closed")
+                return self._lease_locked(self._fixed)
+        from titan_tpu.olap.tpu import snapshot as snap_mod
+
+        key = self.key_of(labels, edge_keys, directed)
+        e0 = self.graph.mutation_epoch
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("pool is closed")
+            snap = self._entries.get(key)
+            if snap is not None and snap.epoch >= e0 and not snap.stale:
+                return self._lease_locked(snap)     # fast path
+            klock = self._keylocks.setdefault(key, threading.Lock())
+        with klock:
+            while True:
+                rebuild_close = None
+                with self._lock:
+                    if self._closed:
+                        raise RuntimeError("pool is closed")
+                    snap = self._entries.get(key)
+                    if snap is not None and snap.epoch >= e0 \
+                            and not snap.stale:
+                        return self._lease_locked(snap)
+                    if snap is not None \
+                            and self._leases.get(id(snap), 0) > 0:
+                        # live runs hold the old arrays: retire, rebuild
+                        self._retired[id(snap)] = snap
+                        self._entries.pop(key, None)
+                        snap = None
+                if snap is None:
+                    new = snap_mod.build(self.graph, labels=labels,
+                                         edge_keys=edge_keys,
+                                         directed=directed)
+                    with self._lock:
+                        self._entries[key] = new
+                        # build()'s epoch-verified scan stamps an epoch
+                        # >= e0 (it started after e0 was sampled)
+                        return self._lease_locked(new)
+                try:
+                    snap.refresh()
+                except (RuntimeError, NotImplementedError):
+                    # delta gap / backlog overflow / edge_values:
+                    # epoch-retry via a full rebuild (build() itself
+                    # retries its scan against racing writers)
+                    rebuild_close = snap
+                    with self._lock:
+                        if self._entries.get(key) is snap:
+                            self._entries.pop(key)
+                    self._close_snap(rebuild_close)
+                    continue
+                if snap.epoch >= e0:
+                    with self._lock:
+                        return self._lease_locked(snap)
+                # a commit landed inside refresh(): loop and re-check
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "active_leases": sum(self._leases.values()),
+                    "retired": len(self._retired)}
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            snaps = list(self._entries.values()) \
+                + list(self._retired.values())
+            self._entries.clear()
+            self._retired.clear()
+            self._leases.clear()
+        for s in snaps:
+            if s is not self._fixed:
+                self._close_snap(s)

@@ -1,0 +1,311 @@
+"""Concurrent job scheduler: priority queue + admission + batch worker.
+
+The control half of the serving layer (reference seam: gremlin-server's
+request executor feeding FulgoraGraphComputer — rebuilt as an explicit
+queue because a TPU graph engine is throughput-bound on device
+residency, not thread-bound):
+
+* submit() enqueues a JobSpec by (priority desc, deadline asc, FIFO);
+* the single worker drains batches: it pops the head job, gathers up to
+  ``max_batch - 1`` more QUEUED jobs with the same batch key
+  (same-snapshot BFS today), leases the snapshot from the epoch-aware
+  pool, admits the group against the HBM ledger (the graph image is
+  pinned for the run, largest-first eviction of idle images), and hands
+  the group to the Batcher;
+* cancellation (queued: immediate; running: level-boundary early-exit),
+  deadlines (EXPIRED before start) and timeouts are job-level paths, so
+  one stuck caller never wedges the queue.
+
+Metrics (utils/metrics.MetricManager):
+  serving.jobs.{submitted,completed,failed,cancelled,expired,timeout}
+  serving.queue.depth            (counter, inc on enqueue / dec on pop)
+  serving.job.latency_ms         (histogram: submit → terminal, p50/p95)
+  serving.job.queue_ms           (histogram: submit → start)
+  serving.batch.occupancy        (histogram: K per executed batch)
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Optional
+
+from titan_tpu.olap.api import JobSpec
+from titan_tpu.olap.serving.batcher import Batcher, batch_key
+from titan_tpu.olap.serving.hbm import (DEFAULT_BUDGET_BYTES,
+                                        AdmissionError, HBMLedger,
+                                        snapshot_csr_bytes)
+from titan_tpu.olap.serving.jobs import Job, JobState
+from titan_tpu.olap.serving.pool import SnapshotPool
+from titan_tpu.utils.metrics import MetricManager
+
+#: job kinds that execute against a pooled snapshot (everything except
+#: host 'callable' delegations)
+_SNAPSHOT_KINDS = ("bfs", "sssp", "pagerank", "wcc", "dense")
+
+_KNOWN_KINDS = _SNAPSHOT_KINDS + ("callable",)
+
+
+class JobScheduler:
+    """One queue + one worker over one graph (or fixed snapshot)."""
+
+    def __init__(self, graph=None, snapshot=None, *, max_batch: int = 16,
+                 hbm_budget_bytes: float = DEFAULT_BUDGET_BYTES,
+                 metrics: Optional[MetricManager] = None,
+                 autostart: bool = True):
+        self.pool = SnapshotPool(graph, snapshot)
+        self.ledger = HBMLedger(hbm_budget_bytes, on_evict=self._evict)
+        self.batcher = Batcher(max_batch=max_batch)
+        self.max_batch = max_batch
+        self._metrics = metrics or MetricManager.instance()
+        self._jobs: dict[str, Job] = {}
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._running_batch = 0
+        self._evictable: dict = {}    # ledger key -> snapshot (cache drop)
+        # retired/closed snapshots must not stay ledger-resident
+        self.pool.on_close = self._forget_snapshot
+        self._worker: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._stop
+
+    def start(self) -> "JobScheduler":
+        if self._worker is None or not self._worker.is_alive():
+            self._stop = False
+            self._worker = threading.Thread(target=self._run,
+                                            name="serving-scheduler",
+                                            daemon=True)
+            self._worker.start()
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout)
+        # queued jobs fail loudly rather than hang their waiters
+        for job in self.jobs():
+            if not job.state.terminal:
+                job.fail("scheduler closed")
+                self._finalize_metrics(job)
+        self.pool.close()
+
+    def _evict(self, key) -> None:
+        """HBM eviction: drop the snapshot's cached device CSR (arrays
+        free when the last jax reference dies)."""
+        snap = self._evictable.pop(key, None)
+        if snap is not None and hasattr(snap, "_hybrid_csr"):
+            delattr(snap, "_hybrid_csr")
+
+    def _forget_snapshot(self, snap) -> None:
+        """Pool close hook: a retired/rebuilt snapshot leaves the HBM
+        ledger (and the evictable map) instead of counting as resident
+        forever."""
+        key = id(snap)
+        self._evictable.pop(key, None)
+        self.ledger.release(key)
+
+    # -- submission surface --------------------------------------------------
+
+    def submit(self, spec: JobSpec) -> Job:
+        if spec.kind not in _KNOWN_KINDS:
+            raise ValueError(f"unknown job kind {spec.kind!r} "
+                             f"(known: {', '.join(_KNOWN_KINDS)})")
+        job = Job(spec)
+        self._metrics.counter("serving.jobs.submitted").inc()
+        if spec.deadline is not None and time.time() > spec.deadline:
+            job.expire()
+            self._finalize_metrics(job)
+            with self._cv:
+                self._jobs[job.id] = job
+            return job
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("scheduler is closed")
+            self._jobs[job.id] = job
+            heapq.heappush(self._heap,
+                           (-spec.priority,
+                            spec.deadline if spec.deadline is not None
+                            else float("inf"),
+                            next(self._seq), job))
+            self._metrics.counter("serving.queue.depth").inc()
+            self._cv.notify()
+        return job
+
+    def get(self, job_id: str) -> Optional[Job]:
+        with self._cv:
+            return self._jobs.get(job_id)
+
+    def cancel(self, job_id: str) -> bool:
+        job = self.get(job_id)
+        if job is None:
+            return False
+        was_queued = job.state is JobState.QUEUED
+        ok = job.cancel()
+        if ok and was_queued and job.state is JobState.CANCELLED:
+            self._finalize_metrics(job)
+        return ok
+
+    def jobs(self) -> list[Job]:
+        with self._cv:
+            return list(self._jobs.values())
+
+    def stats(self) -> dict:
+        with self._cv:
+            depth = sum(1 for *_x, j in self._heap
+                        if j.state is JobState.QUEUED)
+            running = self._running_batch
+            jobs = list(self._jobs.values())
+        by_state: dict = {}
+        for j in jobs:
+            by_state[j.state.value] = by_state.get(j.state.value, 0) + 1
+        return {"queue_depth": depth, "running_batch": running,
+                "jobs_total": len(jobs), "by_state": by_state,
+                "hbm_resident_bytes": self.ledger.resident_bytes(),
+                **{f"pool_{k}": v for k, v in self.pool.stats().items()}}
+
+    # -- worker --------------------------------------------------------------
+
+    _STATE_COUNTER = {JobState.DONE: "completed",
+                      JobState.FAILED: "failed",
+                      JobState.TIMEOUT: "timeout",
+                      JobState.CANCELLED: "cancelled",
+                      JobState.EXPIRED: "expired"}
+
+    def _finalize_metrics(self, job: Job) -> None:
+        """Record a terminal job's state counter + latency sample,
+        exactly once per job (cancel vs worker completion can race)."""
+        if not job.state.terminal or not job.metered_once():
+            return
+        name = self._STATE_COUNTER[job.state]
+        self._metrics.counter(f"serving.jobs.{name}").inc()
+        if job.finished_at is not None:
+            self._metrics.histogram("serving.job.latency_ms").update(
+                (job.finished_at - job.submitted_at) * 1e3)
+
+    def _pop_group(self) -> list[Job]:
+        """Under the cv lock: pop the head runnable job + compatible
+        batchmates; drop cancelled/expired entries on the way."""
+        group: list[Job] = []
+        leftovers: list = []
+        key = None
+        while self._heap:
+            entry = heapq.heappop(self._heap)
+            job = entry[3]
+            if job.state is not JobState.QUEUED:
+                self._metrics.counter("serving.queue.depth").inc(-1)
+                continue       # cancelled while queued (already terminal)
+            if job.spec.deadline is not None and \
+                    time.time() > job.spec.deadline:
+                self._metrics.counter("serving.queue.depth").inc(-1)
+                if job.expire():
+                    self._finalize_metrics(job)
+                continue
+            if not group:
+                group.append(job)
+                self._metrics.counter("serving.queue.depth").inc(-1)
+                key = batch_key(job.spec)
+                if key is None:
+                    break      # unbatchable head runs alone
+                continue
+            if batch_key(job.spec) == key and len(group) < self.max_batch:
+                group.append(job)
+                self._metrics.counter("serving.queue.depth").inc(-1)
+                if len(group) >= self.max_batch:
+                    break      # full batch: stop draining the heap
+            else:
+                leftovers.append(entry)
+        for entry in leftovers:
+            heapq.heappush(self._heap, entry)
+        return group
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and not self._heap:
+                    self._cv.wait(0.1)
+                if self._stop:
+                    return
+                group = self._pop_group()
+                if group:
+                    self._running_batch = len(group)
+            if not group:
+                continue
+            try:
+                self._execute(group)
+            except Exception as e:
+                # belt and braces: NOTHING may kill the single worker
+                # thread (a dead worker leaves every later job QUEUED
+                # forever with no error surfaced) — fail the group and
+                # keep serving
+                for job in group:
+                    job.fail(f"scheduler: {type(e).__name__}: {e}")
+            finally:
+                with self._cv:
+                    self._running_batch = 0
+            for job in group:
+                self._finalize_metrics(job)
+
+    def _execute(self, group: list[Job]) -> None:
+        head = group[0]
+        # cancel raced between pop and start: honor it before any work
+        group = [j for j in group
+                 if not j.state.terminal
+                 and not (j.cancel_requested and j.mark_cancelled())]
+        if not group:
+            return
+        for job in group:
+            job.start()
+            q = job.queue_seconds()
+            if q is not None:
+                self._metrics.histogram("serving.job.queue_ms").update(
+                    q * 1e3)
+        self._metrics.histogram("serving.batch.occupancy").update(
+            float(len(group)))
+        if head.spec.kind == "callable":
+            for job in group:
+                self.batcher.run_single(job, None)
+            return
+        spec = head.spec
+        edge_keys = tuple(spec.edge_keys or ())
+        if spec.kind == "dense" and not edge_keys:
+            # a DenseProgram that reads edge properties needs them
+            # extracted into the snapshot — derive from the program
+            program = spec.params.get("program")
+            if program is not None and hasattr(program, "edge_keys"):
+                edge_keys = tuple(program.edge_keys())
+        try:
+            lease = self.pool.acquire(labels=spec.labels,
+                                      edge_keys=edge_keys,
+                                      directed=spec.directed)
+        except Exception as e:
+            for job in group:
+                job.fail(f"snapshot: {type(e).__name__}: {e}")
+            return
+        with lease as snap:
+            ledger_key = id(snap)
+            try:
+                self.ledger.reserve(ledger_key, snapshot_csr_bytes(snap))
+            except AdmissionError as e:
+                for job in group:
+                    job.fail(str(e))
+                return
+            self._evictable.setdefault(ledger_key, snap)
+            try:
+                if len(group) > 1 or batch_key(spec) is not None:
+                    self.batcher.run_bfs_batch(group, snap)
+                else:
+                    self.batcher.run_single(group[0], snap)
+            finally:
+                self.ledger.unpin(ledger_key)

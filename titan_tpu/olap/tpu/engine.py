@@ -88,6 +88,34 @@ class TPUGraphComputer:
         self._default_snapshot = snapshot
         self._built: dict[tuple, GraphSnapshot] = {}
         self.num_devices = num_devices
+        self._scheduler = None
+
+    # -- async serving delegation (olap/serving) ----------------------------
+
+    def scheduler(self, **kwargs):
+        """The computer's job scheduler (olap/serving.JobScheduler),
+        created lazily and shared by every ``run_async`` call — the
+        L4b end of the serving seam: queued/admitted jobs execute
+        against this computer's graph through the snapshot pool (so a
+        JobSpec's labels/edge_keys/directed select real snapshots),
+        with same-snapshot BFS jobs fused into batched runs. Only a
+        graph-less computer falls back to its fixed snapshot — that
+        pool ignores per-job snapshot parameters (pool contract), so
+        the caller owns making the fixed snapshot fit the jobs (e.g.
+        symmetrized for BFS)."""
+        if self._scheduler is None or self._scheduler.closed:
+            from titan_tpu.olap.serving.scheduler import JobScheduler
+            self._scheduler = JobScheduler(
+                graph=self.graph,
+                snapshot=None if self.graph is not None
+                else self._default_snapshot,
+                **kwargs)
+        return self._scheduler
+
+    def run_async(self, spec):
+        """Submit a JobSpec (olap/api.py) to this computer's scheduler;
+        returns the Job handle immediately."""
+        return self.scheduler().submit(spec)
 
     def snapshot(self, labels=None, edge_keys=(), directed=True) -> GraphSnapshot:
         """Snapshot for the given parameters; cached PER parameter set (a
@@ -130,6 +158,14 @@ class TPUGraphComputer:
         if map_reduces:
             self._run_map_reduces(map_reduces, result, snap, params or {})
         return result
+
+    def run_batched(self, program: DenseProgram, params_list,
+                    snapshot: Optional[GraphSnapshot] = None) -> list:
+        """K parameter sets of one DenseProgram as a single [K, n]
+        batched device run (single-device path; see
+        ``run_single_batched``)."""
+        snap = snapshot or self.snapshot(edge_keys=program.edge_keys())
+        return run_single_batched(program, snap, params_list)
 
     def _run_map_reduces(self, map_reduces, result: "TPUEngineResult",
                          snap: GraphSnapshot, params: dict) -> None:
@@ -208,6 +244,103 @@ def run_single(program: DenseProgram, snap: GraphSnapshot,
     outputs = program.outputs(state, params)
     return TPUEngineResult({k: np.asarray(v) for k, v in outputs.items()},
                            int(iters), n)
+
+
+# ---------------------------------------------------------------------------
+# batched multi-job execution (serving layer: K jobs, [K, ...] state)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(0,), static_argnames=("max_iter", "n"))
+def _iterate_batched(program: DenseProgram, state: dict, src, dst,
+                     edata: dict, seg_meta: tuple, params: dict,
+                     max_iter: int, n: int):
+    """Multi-job BSP: every state leaf carries a leading job axis
+    [K, ...] and the superstep is vmapped over it — the edge arrays are
+    closed over, so the graph stays a single device-resident copy shared
+    by every job. Jobs that report done freeze (their state stops
+    changing) while the rest iterate; the loop exits when all are done.
+    ``it_done[k]`` records the iteration at which job k converged (0 if
+    it ran to max_iter — the caller patches that from ``iters``)."""
+    last_idx, seg_has = seg_meta
+
+    def job_step(st, pr, it):
+        src_state = {k: v[src] for k, v in st.items()}
+        msg = program.message(src_state, edata, pr)
+        agg = segment_combine(msg, dst, n, program.combine,
+                              last_idx=last_idx, seg_has=seg_has)
+        new = program.apply(st, agg, it, pr)
+        return new, program.done(st, new, agg, it, pr)
+
+    def superstep(carry):
+        state, it, done, it_done = carry
+        new_state, jd = jax.vmap(
+            lambda st, pr: job_step(st, pr, it))(state, params)
+        new_state = {
+            k: jnp.where(done.reshape((-1,) + (1,) * (v.ndim - 1)),
+                         state[k], v)
+            for k, v in new_state.items()}
+        jd = jd | done
+        it_done = jnp.where(jd & ~done, it + 1, it_done)
+        return new_state, it + 1, jd, it_done
+
+    def cond(carry):
+        _, it, done, _ = carry
+        return jnp.logical_and(it < max_iter, jnp.logical_not(done.all()))
+
+    K = next(iter(state.values())).shape[0]
+    state, iters, done, it_done = jax.lax.while_loop(
+        cond, superstep,
+        (state, jnp.int32(0), jnp.zeros((K,), bool),
+         jnp.zeros((K,), jnp.int32)))
+    return state, iters, it_done
+
+
+def run_single_batched(program: DenseProgram, snap: GraphSnapshot,
+                       params_list) -> list:
+    """Run ONE DenseProgram for K parameter sets (e.g. K BFS sources) as
+    a single batched device run with state widened to [K, n]: one
+    compiled while_loop, per-job done flags, graph read once per
+    superstep. Per-job results are bit-equal to ``run_single`` with the
+    same params (the vmapped superstep evaluates identical expressions
+    per job). Params must be numeric (int/float/bool/ndarray) and share
+    a key set — they are stacked along the job axis and vmapped.
+
+    Returns a list of TPUEngineResult, one per job (MapReduce stages are
+    not run here — the serving layer aggregates per job if needed)."""
+    params_list = [dict(p or {}) for p in params_list]
+    if not params_list:
+        raise ValueError("run_single_batched needs >= 1 params set")
+    keys = set(params_list[0])
+    for p in params_list[1:]:
+        if set(p) != keys:
+            raise ValueError("batched jobs must share a params key set")
+    for p in params_list:
+        for k, v in p.items():
+            if not isinstance(v, (int, float, bool, np.ndarray)):
+                raise TypeError(
+                    f"run_single_batched params must be numeric; "
+                    f"{k!r} is {type(v).__name__}")
+    n = snap.n
+    states = [{k: jnp.asarray(v)
+               for k, v in program.init(n, p).items()} for p in params_list]
+    state = {k: jnp.stack([s[k] for s in states]) for k in states[0]}
+    src, dst, edata, seg_meta = _device_graph_single(snap)
+    edata = {k: edata[k] for k in program.edge_keys()} \
+        if program.edge_keys() else edata
+    vparams = {k: jnp.stack([jnp.asarray(p[k]) for p in params_list])
+               for k in keys}
+    state, iters, it_done = _iterate_batched(
+        program, state, src, dst, edata, seg_meta, vparams,
+        max_iter=program.max_iterations, n=n)
+    it_done_h = np.asarray(it_done)
+    iters_h = int(iters)
+    results = []
+    for i, p in enumerate(params_list):
+        out = program.outputs({k: v[i] for k, v in state.items()}, p)
+        results.append(TPUEngineResult(
+            {k: np.asarray(v) for k, v in out.items()},
+            int(it_done_h[i]) or iters_h, n))
+    return results
 
 
 # ---------------------------------------------------------------------------

@@ -15,6 +15,15 @@ Endpoints:
                       evaluated against bindings {g, P, graph}; like Gremlin
                       Server's script engine, the endpoint executes caller
                       scripts — deploy it only where the caller is trusted.
+  POST   /jobs      — submit an async OLAP job (olap/serving): body
+                      {"kind": "bfs", "source": <vertex id>, ...,
+                       "priority": 0, "timeout_s": 30, "deadline_s": 60,
+                       "targets": [ids]} → 202 {"job": id}. Same-snapshot
+                      BFS jobs fuse into one batched [K, n] device run.
+  GET    /jobs      — scheduler stats + job summaries
+  GET    /jobs/<id> — job status/result/metrics envelope
+  DELETE /jobs/<id> — cancel (queued: immediate; running: at the next
+                      level boundary via the per-job early-exit mask)
 
 Server config is a YAML file (gremlin-server.yaml analog):
   host: 127.0.0.1
@@ -90,13 +99,59 @@ class GraphServer:
     credential gate for a script-evaluating endpoint."""
 
     def __init__(self, graph, host: str = "127.0.0.1", port: int = 8182,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None, scheduler=None):
         self.graph = graph
         self.host = host
         self.port = port
         self.auth_token = auth_token
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._scheduler = scheduler
+        self._sched_lock = threading.Lock()
+
+    # -- async job plane (olap/serving) --------------------------------------
+
+    def scheduler(self):
+        """The server's job scheduler, created lazily on the first /jobs
+        request (tests may inject one — e.g. autostart=False to pin
+        batch composition)."""
+        with self._sched_lock:
+            if self._scheduler is None or self._scheduler.closed:
+                from titan_tpu.olap.serving.scheduler import JobScheduler
+                self._scheduler = JobScheduler(graph=self.graph)
+            return self._scheduler
+
+    def submit_job(self, body: dict):
+        """Wire body → JobSpec → scheduler (shared by POST /jobs and the
+        smoke script). ``deadline_s`` is relative to now; params carry
+        kind-specific fields (source, targets, iterations, ...)."""
+        import time as _time
+
+        from titan_tpu.olap.api import JobSpec
+        kind = body.get("kind", "bfs")
+        params = dict(body.get("params") or {})
+        for key in ("source", "source_dense", "targets", "max_levels",
+                    "iterations", "damping", "delta", "quantile_mass"):
+            if key in body:
+                params[key] = body[key]
+        deadline = None
+        if body.get("deadline_s") is not None:
+            deadline = _time.time() + float(body["deadline_s"])
+        # numeric fields are coerced HERE, at the untrusted boundary — a
+        # string timeout_s would otherwise detonate inside the fused
+        # batch's level callback and fail every batchmate
+        timeout_s = None
+        if body.get("timeout_s") is not None:
+            timeout_s = float(body["timeout_s"])
+        if "max_levels" in params:
+            params["max_levels"] = int(params["max_levels"])
+        spec = JobSpec(kind=kind, params=params,
+                       priority=int(body.get("priority", 0)),
+                       deadline=deadline,
+                       timeout_s=timeout_s,
+                       labels=body.get("labels"),
+                       directed=bool(body.get("directed", False)))
+        return self.scheduler().submit(spec)
 
     # -- script evaluation ---------------------------------------------------
 
@@ -187,18 +242,47 @@ class GraphServer:
                     self._send(200, {"types": [
                         {"name": t.name, "id": t.id,
                          "kind": type(t).__name__} for t in types]})
+                elif self.path == "/jobs":
+                    sched = server.scheduler()
+                    self._send(200, {
+                        "stats": sched.stats(),
+                        "jobs": [j.to_wire() for j in sched.jobs()]})
+                elif self.path.startswith("/jobs/"):
+                    job = server.scheduler().get(
+                        self.path[len("/jobs/"):])
+                    if job is None:
+                        self._send(404, {"error": "unknown job",
+                                         "type": "NotFound",
+                                         "retryable": False})
+                    else:
+                        self._send(200, job.to_wire())
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):
                 if not self._authorized():
                     return
-                if self.path != "/traversal":
+                if self.path not in ("/traversal", "/jobs"):
                     self._send(404, {"error": f"unknown path {self.path}",
                                      "type": "NotFound",
                                      "retryable": False})
                     return
                 length = int(self.headers.get("Content-Length", 0))
+                if self.path == "/jobs":
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        job = server.submit_job(body)
+                    except (json.JSONDecodeError, ValueError,
+                            TypeError) as e:
+                        self._send(400, {"error": str(e),
+                                         "type": type(e).__name__,
+                                         "retryable": False})
+                        return
+                    except BaseException as e:
+                        self._send(*wire_error(e))
+                        return
+                    self._send(202, job.to_wire())
+                    return
                 try:
                     req = json.loads(self.rfile.read(length) or b"{}")
                     script = req["gremlin"]
@@ -215,6 +299,30 @@ class GraphServer:
                     return
                 self._send(200, {"result": jsonify(result)})
 
+            def do_DELETE(self):
+                if not self._authorized():
+                    return
+                if not self.path.startswith("/jobs/"):
+                    self._send(404, {"error": f"unknown path {self.path}",
+                                     "type": "NotFound",
+                                     "retryable": False})
+                    return
+                sched = server.scheduler()
+                job_id = self.path[len("/jobs/"):]
+                job = sched.get(job_id)
+                if job is None:
+                    self._send(404, {"error": "unknown job",
+                                     "type": "NotFound",
+                                     "retryable": False})
+                elif sched.cancel(job_id):
+                    self._send(200, job.to_wire())
+                else:
+                    self._send(409, {"error": f"job already "
+                                              f"{job.state.value}",
+                                     "type": "Conflict",
+                                     "retryable": False,
+                                     **job.to_wire()})
+
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]   # resolve port 0
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -227,6 +335,9 @@ class GraphServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        with self._sched_lock:
+            if self._scheduler is not None and not self._scheduler.closed:
+                self._scheduler.close()
 
 
 def from_yaml(path: str) -> GraphServer:

@@ -75,6 +75,64 @@ class Timer:
         return self.total_ns / self.count if self.count else 0.0
 
 
+class Histogram:
+    """Sampled value distribution with percentiles — the serving layer's
+    p50/p95 job-latency and batch-occupancy metric (the reference's
+    Dropwizard histograms play this role; docs/monitoring.txt latency
+    domains). Bounded reservoir (Vitter's algorithm R, deterministic
+    LCG so snapshots are reproducible): under ``max_samples`` updates
+    the percentiles are exact, beyond that a uniform sample."""
+
+    def __init__(self, max_samples: int = 2048):
+        self._max = max_samples
+        self._samples: list[float] = []
+        self.count = 0
+        self.total = 0.0
+        self.min = 0.0
+        self.max = 0.0
+        self._rng_state = 0x2545F4914F6CDD1D
+        self._lock = threading.Lock()
+
+    def _rand(self, bound: int) -> int:
+        self._rng_state = (self._rng_state * 6364136223846793005
+                           + 1442695040888963407) & (2**64 - 1)
+        return (self._rng_state >> 33) % bound
+
+    def update(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            if self.count == 0 or value < self.min:
+                self.min = value
+            if self.count == 0 or value > self.max:
+                self.max = value
+            self.count += 1
+            self.total += value
+            if len(self._samples) < self._max:
+                self._samples.append(value)
+            else:
+                i = self._rand(self.count)
+                if i < self._max:
+                    self._samples[i] = value
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; nearest-rank over the reservoir."""
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            s = sorted(self._samples)
+        rank = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
+        return s[rank]
+
+    def to_dict(self) -> dict:
+        return {"count": self.count, "mean": self.mean, "min": self.min,
+                "max": self.max, "p50": self.percentile(50),
+                "p95": self.percentile(95)}
+
+
 class MetricManager:
     """Named-metric registry. One shared default instance (the reference's
     ``MetricManager.INSTANCE`` singleton), but independently constructible
@@ -86,6 +144,7 @@ class MetricManager:
     def __init__(self):
         self._counters: dict[str, Counter] = {}
         self._timers: dict[str, Timer] = {}
+        self._histograms: dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
     @classmethod
@@ -109,6 +168,13 @@ class MetricManager:
                 t = self._timers.setdefault(name, Timer())
         return t
 
+    def histogram(self, name: str) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram())
+        return h
+
     def counter_value(self, name: str) -> int:
         c = self._counters.get(name)
         return c.count if c is not None else 0
@@ -129,12 +195,15 @@ class MetricManager:
                          "min_ms": t.min_ns / 1e6,
                          "max_ms": t.max_ns / 1e6,
                          "total_ms": t.total_ns / 1e6}
+        for name, h in sorted(self._histograms.items()):
+            out[name] = h.to_dict()
         return out
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._timers.clear()
+            self._histograms.clear()
 
     # -- reporters (reference: console/CSV reporters,
     #    GraphDatabaseConfiguration.java:1010-1226) --------------------------
@@ -142,9 +211,13 @@ class MetricManager:
     def report_console(self, out=None) -> str:
         buf = io.StringIO()
         for name, val in self.snapshot().items():
-            if isinstance(val, dict):
+            if isinstance(val, dict) and "mean_ms" in val:
                 buf.write(f"{name}: count={val['count']} "
                           f"mean={val['mean_ms']:.3f}ms max={val['max_ms']:.3f}ms\n")
+            elif isinstance(val, dict):     # histogram
+                buf.write(f"{name}: count={val['count']} "
+                          f"p50={val['p50']:.3f} p95={val['p95']:.3f} "
+                          f"max={val['max']:.3f}\n")
             else:
                 buf.write(f"{name}: {val}\n")
         text = buf.getvalue()
@@ -157,9 +230,12 @@ class MetricManager:
             w = csv.writer(f)
             w.writerow(["metric", "count", "mean_ms", "min_ms", "max_ms"])
             for name, val in self.snapshot().items():
-                if isinstance(val, dict):
+                if isinstance(val, dict) and "mean_ms" in val:
                     w.writerow([name, val["count"], f"{val['mean_ms']:.6f}",
                                 f"{val['min_ms']:.6f}", f"{val['max_ms']:.6f}"])
+                elif isinstance(val, dict):     # histogram (raw units)
+                    w.writerow([name, val["count"], f"{val['mean']:.6f}",
+                                f"{val['min']:.6f}", f"{val['max']:.6f}"])
                 else:
                     w.writerow([name, val, "", "", ""])
 
@@ -258,10 +334,11 @@ def _csv_emit(directory: str):
                             "min_ms", "max_ms"])
             for name, val in manager.snapshot().items():
                 if isinstance(val, dict):
+                    mean = val.get("mean_ms", val.get("mean", 0.0))
+                    lo = val.get("min_ms", val.get("min", 0.0))
+                    hi = val.get("max_ms", val.get("max", 0.0))
                     w.writerow([f"{ts:.3f}", name, val["count"],
-                                f"{val['mean_ms']:.6f}",
-                                f"{val['min_ms']:.6f}",
-                                f"{val['max_ms']:.6f}"])
+                                f"{mean:.6f}", f"{lo:.6f}", f"{hi:.6f}"])
                 else:
                     w.writerow([f"{ts:.3f}", name, val, "", "", ""])
     return emit
@@ -275,10 +352,14 @@ def _graphite_emit(host: str, port: int, prefix: str):
         t = int(ts)
         for name, val in manager.snapshot().items():
             key = f"{prefix}.{name}".replace(" ", "_")
-            if isinstance(val, dict):
+            if isinstance(val, dict) and "mean_ms" in val:
                 lines.append(f"{key}.count {val['count']} {t}\n")
                 lines.append(f"{key}.mean_ms {val['mean_ms']:.6f} {t}\n")
                 lines.append(f"{key}.max_ms {val['max_ms']:.6f} {t}\n")
+            elif isinstance(val, dict):     # histogram
+                lines.append(f"{key}.count {val['count']} {t}\n")
+                lines.append(f"{key}.p50 {val['p50']:.6f} {t}\n")
+                lines.append(f"{key}.p95 {val['p95']:.6f} {t}\n")
             else:
                 lines.append(f"{key} {val} {t}\n")
         with socket.create_connection((host, port), timeout=5.0) as s:
