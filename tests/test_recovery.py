@@ -1,0 +1,543 @@
+"""Checkpoint & recovery plane (olap/recovery + serving integration).
+
+The acceptance contract (ISSUE r8): for each of BFS / SSSP / WCC /
+PageRank, a run crashed at an injected round k and resumed from its
+newest checkpoint produces final arrays BIT-EQUAL to an uninterrupted
+run; a corrupted checkpoint is rejected by digest and recovery falls
+back to the previous valid one (or a clean restart), never a wrong
+answer. Faults are injected deterministically (recovery/faults.py) so
+every path runs without flakiness.
+
+Graph shapes: ONE vertex count (the same n=192 / m=900 seed-42 arrays
+as tests/test_serving.py) across every kernel test in this file — the
+round kernels compile per power-of-two capacity bucket and tier-1 is
+serial and budgeted, so sharing shapes shares every XLA compile with
+the serving suite.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from titan_tpu.olap.api import JobSpec
+from titan_tpu.olap.recovery import (CheckpointInvalid, CheckpointStore,
+                                     FaultPlan, InjectedFault,
+                                     SnapshotEvicted)
+from titan_tpu.olap.serving.scheduler import JobScheduler
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.utils.metrics import MetricManager
+
+_N = 192
+
+
+def _sym_snapshot(seed: int, n: int = _N, m: int = 900):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    return snap_mod.from_arrays(n, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+
+
+@pytest.fixture(scope="module")
+def snap_main():
+    return _sym_snapshot(42)
+
+
+@pytest.fixture
+def metrics():
+    return MetricManager()
+
+
+def _source(snap) -> int:
+    return int(np.flatnonzero(snap.out_degree > 0)[0])
+
+
+def _run_recovered(snap, spec: JobSpec, metrics, tmp_path, timeout=120.0):
+    """Submit one job on a checkpointing scheduler; return the DONE job
+    (asserting it finished)."""
+    sched = JobScheduler(snapshot=snap, metrics=metrics,
+                         checkpoint_dir=str(tmp_path / "ckpt"))
+    try:
+        job = sched.submit(spec)
+        assert job.wait(timeout), "job did not reach a terminal state"
+        return job
+    finally:
+        sched.close()
+
+
+# --------------------------------------------------------------------------
+# store: manifest + digests + atomic commit
+# --------------------------------------------------------------------------
+
+def test_store_roundtrip_and_ordering(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    a1 = {"dist": np.arange(16, dtype=np.int32)}
+    st.save("j1", attempt=1, round_=10, kind="bfs", arrays=a1,
+            meta={"epoch": 3})
+    st.save("j1", attempt=2, round_=5, kind="bfs",
+            arrays={"dist": np.arange(16, dtype=np.int32) * 2})
+    # newest ATTEMPT wins even at a lower round (attempt 2 restarted
+    # because attempt 1's trajectory was abandoned)
+    ck = st.latest("j1")
+    assert (ck.attempt, ck.round) == (2, 5)
+    assert (ck.arrays["dist"] == np.arange(16, dtype=np.int32) * 2).all()
+    # per-job isolation
+    assert st.latest("j2") is None
+    # meta + kind survive the roundtrip
+    ck1 = st.load(st.checkpoints("j1")[0])
+    assert ck1.meta == {"epoch": 3} and ck1.kind == "bfs"
+
+
+def test_store_objects_payload_roundtrip(tmp_path):
+    """Host-object payloads (host BSP computer state) are digest-checked
+    pickles."""
+    st = CheckpointStore(str(tmp_path))
+    payload = {"states": {1: {"n": 2}}, "memory": {"x": 1.5}}
+    st.save("j1", attempt=1, round_=2, kind="host", objects=payload)
+    ck = st.latest("j1")
+    assert ck.objects == payload
+
+
+def test_store_detects_torn_and_corrupt_writes(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    p1 = st.save("j1", attempt=1, round_=1, kind="bfs",
+                 arrays={"dist": np.arange(64, dtype=np.int32)})
+    p2 = st.save("j1", attempt=1, round_=2, kind="bfs",
+                 arrays={"dist": np.arange(64, dtype=np.int32) + 1})
+    # a torn write is a tmp dir that never got renamed: invisible
+    os.makedirs(os.path.join(str(tmp_path), "j1",
+                             ".tmp-ckpt-a0001-r00000003-999"))
+    assert st.latest("j1").round == 2
+    # corrupt the newest payload: digest rejects it, latest() falls
+    # back to the previous valid checkpoint
+    FaultPlan.corrupt(p2)
+    assert not st.validate(p2)
+    with pytest.raises(CheckpointInvalid):
+        st.load(p2)
+    assert st.latest("j1").round == 1
+    # corrupt the fallback too: no usable checkpoint -> clean restart
+    FaultPlan.corrupt(p1)
+    assert st.latest("j1") is None
+
+
+def test_store_detects_manifest_garble(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    p = st.save("j1", attempt=1, round_=1, kind="bfs",
+                arrays={"dist": np.zeros(8, np.int32)})
+    with open(os.path.join(p, "manifest.json"), "w") as f:
+        f.write("{not json")
+    assert st.latest("j1") is None
+
+
+# --------------------------------------------------------------------------
+# fault injector: deterministic by construction
+# --------------------------------------------------------------------------
+
+def test_fault_plan_is_deterministic():
+    assert FaultPlan.seeded(7, 10) == FaultPlan.seeded(7, 10)
+    plan = FaultPlan(crash_at_round=3)
+    plan.check(2, attempt=1)                       # not yet
+    with pytest.raises(InjectedFault):
+        plan.check(3, attempt=1)
+    plan.check(3, attempt=2)                       # retry runs clean
+    ev = FaultPlan(evict_at_round=1)
+    with pytest.raises(SnapshotEvicted):
+        ev.check(1, attempt=1)
+
+
+# --------------------------------------------------------------------------
+# kernel-level resume: bit-equal continuation (no scheduler)
+# --------------------------------------------------------------------------
+
+def test_bfs_batched_resume_bit_equal(snap_main):
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_batched
+
+    s = _source(snap_main)
+    caps = {}
+
+    def ck(level, dist, act):
+        caps[level] = np.asarray(dist[:, :snap_main.n]).copy()
+
+    ref, levels, comp = frontier_bfs_batched(snap_main, [s], checkpoint=ck)
+    assert comp.all() and len(caps) >= 2
+    ks = sorted(caps)
+    for k in (ks[1], ks[-1]):       # an early and the last boundary
+        d2, lv2, c2 = frontier_bfs_batched(snap_main, [s],
+                                           init_dist=caps[k],
+                                           start_level=k)
+        assert c2.all() and (d2 == ref).all(), f"level {k}"
+        assert (lv2 == levels).all()
+
+
+def test_sssp_resume_bit_equal(snap_main):
+    from titan_tpu.models.frontier import frontier_sssp
+
+    s = _source(snap_main)
+    caps = {}
+
+    def ck(rounds, state):
+        caps[rounds] = {"val": np.asarray(state["val"]).copy(),
+                        "val_exp": np.asarray(state["val_exp"]).copy(),
+                        "bucket_end": state["bucket_end"],
+                        "quantile_mass": state["quantile_mass"]}
+
+    ref, ref_rounds = frontier_sssp(snap_main, s, checkpoint=ck)
+    mids = [r for r in sorted(caps) if r > 0]
+    assert mids, "sssp finished in one round — no boundary to resume"
+    resume = dict(caps[mids[len(mids) // 2]])
+    resume["rounds"] = mids[len(mids) // 2]
+    got, rounds = frontier_sssp(snap_main, s, resume=resume)
+    assert (np.asarray(got) == np.asarray(ref)).all()
+    assert rounds == ref_rounds
+
+
+def test_wcc_resume_bit_equal(snap_main):
+    from titan_tpu.models.frontier import frontier_wcc
+
+    caps = {}
+
+    def ck(rounds, state):
+        caps[rounds] = {"val": np.asarray(state["val"]).copy(),
+                        "val_exp": np.asarray(state["val_exp"]).copy(),
+                        "levels": state["levels"]}
+
+    ref, ref_rounds = frontier_wcc(snap_main, checkpoint=ck)
+    assert caps, "wcc ran no propagation rounds"
+    r0 = sorted(caps)[-1]
+    resume = dict(caps[r0])
+    resume["rounds"] = r0
+    got, rounds = frontier_wcc(snap_main, resume=resume)
+    assert (np.asarray(got) == np.asarray(ref)).all()
+    assert rounds == ref_rounds       # levels restored from the capture
+
+
+def test_pagerank_resume_bit_equal(snap_main):
+    from titan_tpu.models.frontier import pagerank_dense
+
+    caps = {}
+
+    def ck(it, state):
+        caps[it] = np.asarray(state["rank"]).copy()
+
+    ref, ref_iters = pagerank_dense(snap_main, iterations=10,
+                                    checkpoint=ck)
+    assert sorted(caps) == list(range(1, 11))
+    got, iters = pagerank_dense(snap_main, iterations=10,
+                                resume={"rank": caps[5], "it": 5})
+    assert (np.asarray(got) == np.asarray(ref)).all()
+    assert iters == ref_iters
+
+
+# --------------------------------------------------------------------------
+# engine: chunked DenseProgram execution + TPUGraphComputer resume_from
+# --------------------------------------------------------------------------
+
+def test_engine_chunked_run_bit_equal_and_resumes(snap_main):
+    from titan_tpu.models.bfs import BFS
+    from titan_tpu.olap.tpu.engine import run_single
+
+    prog = BFS(max_iterations=100)
+    s = _source(snap_main)
+    ref = run_single(prog, snap_main, {"source_dense": s})
+    caps = {}
+    got = run_single(prog, snap_main, {"source_dense": s},
+                     checkpoint=lambda it, st: caps.__setitem__(
+                         it, {k: np.asarray(v) for k, v in st.items()}),
+                     checkpoint_every=2)
+    assert (got["dist"] == ref["dist"]).all()
+    assert got.iterations == ref.iterations
+    # resume from a mid-run boundary
+    mid = sorted(caps)[0]
+    res = run_single(prog, snap_main, {"source_dense": s},
+                     resume={"state": caps[mid], "iteration": mid})
+    assert (res["dist"] == ref["dist"]).all()
+    assert res.iterations == ref.iterations
+
+
+def test_computer_resume_from_checkpoint_dir(snap_main, tmp_path):
+    """TPUGraphComputer.run(resume_from=...) reloads the newest VALID
+    checkpoint under the path (a corrupted newest one is skipped by
+    digest) and continues to the same final arrays."""
+    from titan_tpu.models.bfs import BFS
+    from titan_tpu.olap.tpu.engine import TPUGraphComputer, run_single
+
+    s = _source(snap_main)
+    comp = TPUGraphComputer(snapshot=snap_main, num_devices=1)
+    ref = run_single(BFS(max_iterations=100), snap_main,
+                     {"source_dense": s})
+    ckdir = str(tmp_path / "run-ckpt")
+    # a run truncated by its iteration cap leaves checkpoints behind...
+    comp.run(BFS(max_iterations=2), {"source_dense": s},
+             checkpoint_to=ckdir, checkpoint_every=1)
+    # ...corrupt the newest so resume must fall back a round...
+    store = CheckpointStore(ckdir)
+    FaultPlan.corrupt(store.checkpoints("run")[-1])
+    # ...and the resumed full run still converges bit-equal
+    got = comp.run(BFS(max_iterations=100), {"source_dense": s},
+                   resume_from=ckdir)
+    assert (got["dist"] == ref["dist"]).all()
+    with pytest.raises(ValueError):
+        TPUGraphComputer(snapshot=snap_main, num_devices=2).run(
+            BFS(), {"source_dense": s}, resume_from=ckdir)
+
+
+def test_host_computer_checkpoint_resume():
+    """Host BSP computer: superstep state (vertex states + memory)
+    checkpoints as an object payload and a resumed run reaches the same
+    final states and iteration count."""
+    import titan_tpu
+    from titan_tpu.olap.api import VertexProgram
+    from titan_tpu.olap.computer import HostGraphComputer
+
+    class CountProgram(VertexProgram):
+        def execute(self, vertex, messenger, memory):
+            vertex.set_state("n", vertex.get_state("n", 0) + 1)
+
+        def terminate(self, memory):
+            return memory.iteration >= 4
+
+    g = titan_tpu.open("inmemory")
+    try:
+        tx = g.new_transaction()
+        for i in range(4):
+            tx.add_vertex("node", name=f"v{i}")
+        tx.commit()
+        comp = HostGraphComputer(g, num_threads=1)
+        caps = {}
+        ref = comp.run(CountProgram(), checkpoint_every=2,
+                       checkpoint=lambda it, p: caps.__setitem__(it, p))
+        assert ref.iterations == 5 and 2 in caps
+        got = comp.run(CountProgram(), resume=caps[2])
+        assert got.iterations == ref.iterations
+        assert got.states == ref.states
+    finally:
+        g.close()
+
+
+# --------------------------------------------------------------------------
+# end-to-end: injected crash -> RETRYING -> resume -> bit-equal result
+# --------------------------------------------------------------------------
+
+def test_recovered_bfs_job_bit_equal(snap_main, metrics, tmp_path):
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+
+    s = _source(snap_main)
+    job = _run_recovered(
+        snap_main,
+        JobSpec(kind="bfs",
+                params={"source_dense": s,
+                        "faults": FaultPlan(crash_at_round=2)},
+                max_retries=1, checkpoint_every=1, retry_backoff_s=0.01),
+        metrics, tmp_path)
+    assert job.state.value == "done", job.error
+    assert job.attempt == 2 and job.checkpoint_round is not None
+    ref, _ = frontier_bfs_hybrid(snap_main, s)
+    assert (job.result["dist"] == np.asarray(ref)).all()
+    assert metrics.counter_value("serving.recovery.resumes") == 1
+    assert metrics.counter_value("serving.recovery.retries") == 1
+    assert metrics.counter_value("serving.recovery.checkpoints") >= 1
+    wire = job.to_wire()
+    assert wire["attempt"] == 2 and "checkpoint_round" in wire
+
+
+def test_recovered_sssp_job_bit_equal(snap_main, metrics, tmp_path):
+    from titan_tpu.models.frontier import frontier_sssp
+
+    s = _source(snap_main)
+    job = _run_recovered(
+        snap_main,
+        JobSpec(kind="sssp",
+                params={"source_dense": s,
+                        "faults": FaultPlan(crash_at_round=4)},
+                max_retries=1, checkpoint_every=1, retry_backoff_s=0.01),
+        metrics, tmp_path)
+    assert job.state.value == "done", job.error
+    assert job.attempt == 2
+    ref, _ = frontier_sssp(snap_main, s)
+    assert (job.result["dist"] == np.asarray(ref)).all()
+    assert metrics.counter_value("serving.recovery.resumes") == 1
+
+
+def test_recovered_pagerank_job_bit_equal(snap_main, metrics, tmp_path):
+    from titan_tpu.models.frontier import pagerank_dense
+
+    job = _run_recovered(
+        snap_main,
+        JobSpec(kind="pagerank",
+                params={"iterations": 8,
+                        "faults": FaultPlan(crash_at_round=4)},
+                max_retries=1, checkpoint_every=2, retry_backoff_s=0.01),
+        metrics, tmp_path)
+    assert job.state.value == "done", job.error
+    assert job.attempt == 2
+    ref, _ = pagerank_dense(snap_main, iterations=8)
+    assert (job.result["rank"] == np.asarray(ref)).all()
+
+
+def test_recovered_wcc_job_bit_equal(snap_main, metrics, tmp_path):
+    """The BFS peel settles this graph's labels before any propagation
+    round, so the crash at round 0 lands before the first cadence
+    checkpoint — recovery takes the clean-restart path (resumes == 0)
+    and must still be bit-equal."""
+    from titan_tpu.models.frontier import frontier_wcc
+
+    job = _run_recovered(
+        snap_main,
+        JobSpec(kind="wcc",
+                params={"faults": FaultPlan(crash_at_round=0)},
+                max_retries=1, checkpoint_every=1, retry_backoff_s=0.01),
+        metrics, tmp_path)
+    assert job.state.value == "done", job.error
+    assert job.attempt == 2
+    ref, ref_rounds = frontier_wcc(snap_main)
+    assert (job.result["labels"] == np.asarray(ref)).all()
+    assert job.result["rounds"] == ref_rounds
+
+
+def test_corrupted_checkpoint_falls_back_then_bit_equal(
+        snap_main, metrics, tmp_path):
+    """The newest checkpoint is corrupted on disk after commit: resume
+    must reject it by digest (serving.recovery.invalid_checkpoints),
+    adopt the previous valid one, and still produce the exact result."""
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+
+    s = _source(snap_main)
+    job = _run_recovered(
+        snap_main,
+        JobSpec(kind="bfs",
+                params={"source_dense": s,
+                        "faults": FaultPlan(crash_at_round=4,
+                                            corrupt_at_round=3)},
+                max_retries=1, checkpoint_every=1, retry_backoff_s=0.01),
+        metrics, tmp_path)
+    assert job.state.value == "done", job.error
+    ref, _ = frontier_bfs_hybrid(snap_main, s)
+    assert (job.result["dist"] == np.asarray(ref)).all()
+    assert metrics.counter_value(
+        "serving.recovery.invalid_checkpoints") >= 1
+    assert metrics.counter_value("serving.recovery.resumes") == 1
+
+
+@pytest.mark.slow
+def test_snapshot_eviction_mid_job_recovers(snap_main, metrics, tmp_path):
+    """Injected mid-job loss of device residency: the retry re-uploads
+    from host arrays and resumes from checkpoint, bit-equal."""
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+
+    s = _source(snap_main)
+    job = _run_recovered(
+        snap_main,
+        JobSpec(kind="bfs",
+                params={"source_dense": s,
+                        "faults": FaultPlan(evict_at_round=2)},
+                max_retries=1, checkpoint_every=1, retry_backoff_s=0.01),
+        metrics, tmp_path)
+    assert job.state.value == "done", job.error
+    assert "SnapshotEvicted" in (job.error or "") or job.attempt == 2
+    ref, _ = frontier_bfs_hybrid(snap_main, s)
+    assert (job.result["dist"] == np.asarray(ref)).all()
+
+
+@pytest.mark.slow
+def test_no_checkpoint_dir_retries_restart_clean(snap_main, metrics):
+    """Fault plans work without a checkpoint store: the retry restarts
+    from scratch (resumes == 0) and still completes correctly."""
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+
+    s = _source(snap_main)
+    sched = JobScheduler(snapshot=snap_main, metrics=metrics)
+    try:
+        job = sched.submit(JobSpec(
+            kind="bfs",
+            params={"source_dense": s,
+                    "faults": FaultPlan(crash_at_round=2)},
+            max_retries=1, checkpoint_every=1, retry_backoff_s=0.01))
+        assert job.wait(60)
+    finally:
+        sched.close()
+    assert job.state.value == "done", job.error
+    assert job.attempt == 2
+    assert metrics.counter_value("serving.recovery.resumes") == 0
+    assert metrics.counter_value("serving.recovery.rounds_replayed") >= 1
+    ref, _ = frontier_bfs_hybrid(snap_main, s)
+    assert (job.result["dist"] == np.asarray(ref)).all()
+
+
+@pytest.mark.slow
+def test_dense_fault_without_store_still_fires(snap_main, metrics):
+    """Fault injection on a 'dense' job must work WITHOUT a checkpoint
+    store (the chunked loop is forced so the boundary hook exists):
+    crash -> clean-restart retry -> correct result."""
+    from titan_tpu.models.bfs import BFS
+    from titan_tpu.olap.tpu.engine import run_single
+
+    s = _source(snap_main)
+    sched = JobScheduler(snapshot=snap_main, metrics=metrics)
+    try:
+        job = sched.submit(JobSpec(
+            kind="dense",
+            params={"program": BFS(max_iterations=100), "source_dense": s,
+                    "faults": FaultPlan(crash_at_round=2)},
+            max_retries=1, retry_backoff_s=0.01))
+        assert job.wait(120)
+    finally:
+        sched.close()
+    assert job.state.value == "done", job.error
+    assert job.attempt == 2
+    ref = run_single(BFS(max_iterations=100), snap_main,
+                     {"source_dense": s})
+    assert (job.result["dist"] == ref["dist"]).all()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind,crash_at", [
+    ("bfs", 1), ("bfs", 3), ("sssp", 2), ("sssp", 6),
+    ("pagerank", 2), ("pagerank", 6), ("wcc", 0),
+])
+def test_fault_matrix_crash_positions(snap_main, metrics, tmp_path,
+                                      kind, crash_at):
+    """Slow sweep: crash position must not matter — every (kind, k)
+    recovers bit-equal (CI tier; tier-1 covers one k per kind)."""
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+    from titan_tpu.models.frontier import (frontier_sssp, frontier_wcc,
+                                           pagerank_dense)
+
+    s = _source(snap_main)
+    params = {"faults": FaultPlan(crash_at_round=crash_at)}
+    if kind in ("bfs", "sssp"):
+        params["source_dense"] = s
+    if kind == "pagerank":
+        params["iterations"] = 8
+    job = _run_recovered(
+        snap_main,
+        JobSpec(kind=kind, params=params, max_retries=2,
+                checkpoint_every=1, retry_backoff_s=0.01),
+        metrics, tmp_path)
+    assert job.state.value == "done", job.error
+    if kind == "bfs":
+        ref = frontier_bfs_hybrid(snap_main, s)[0]
+        assert (job.result["dist"] == np.asarray(ref)).all()
+    elif kind == "sssp":
+        ref = frontier_sssp(snap_main, s)[0]
+        assert (job.result["dist"] == np.asarray(ref)).all()
+    elif kind == "pagerank":
+        ref = pagerank_dense(snap_main, iterations=8)[0]
+        assert (job.result["rank"] == np.asarray(ref)).all()
+    else:
+        ref = frontier_wcc(snap_main)[0]
+        assert (job.result["labels"] == np.asarray(ref)).all()
+
+
+@pytest.mark.slow
+def test_slow_write_fault_still_recovers(snap_main, metrics, tmp_path):
+    job = _run_recovered(
+        snap_main,
+        JobSpec(kind="bfs",
+                params={"source_dense": _source(snap_main),
+                        "faults": FaultPlan(crash_at_round=3,
+                                            slow_write_s=0.05)},
+                max_retries=1, checkpoint_every=1, retry_backoff_s=0.01),
+        metrics, tmp_path)
+    assert job.state.value == "done", job.error

@@ -1,0 +1,282 @@
+"""Scheduler retry policy + lifecycle races (no device kernels).
+
+Covers the control half of the recovery plane with 'callable' jobs so
+the suite never touches jax (tier-1 is compile-budgeted): RETRYING
+transitions, exponential backoff gating, retry exhaustion, cancel /
+close interactions, the submitted-vs-rejected metrics fix, and the
+close-during-RUNNING race (a job can never go DONE after FAILED and
+its terminal metrics fire exactly once).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from titan_tpu.olap.api import JobSpec
+from titan_tpu.olap.serving.jobs import JobState
+from titan_tpu.olap.serving.scheduler import JobScheduler
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.utils.metrics import MetricManager
+
+
+def _tiny_snapshot():
+    # callable jobs never lease it; the pool just needs something
+    return snap_mod.from_arrays(4, np.array([0, 1], np.int32),
+                                np.array([1, 0], np.int32))
+
+
+@pytest.fixture
+def metrics():
+    return MetricManager()
+
+
+@pytest.fixture
+def sched(metrics):
+    s = JobScheduler(snapshot=_tiny_snapshot(), metrics=metrics)
+    yield s
+    s.close()
+
+
+def _flaky(n_failures: int, calls: list):
+    """A callable that records call times and fails its first
+    ``n_failures`` invocations."""
+    def fn():
+        calls.append(time.time())
+        if len(calls) <= n_failures:
+            raise RuntimeError(f"boom #{len(calls)}")
+        return 41 + len(calls)
+    return fn
+
+
+def test_retry_then_done(sched, metrics):
+    calls = []
+    job = sched.submit(JobSpec(kind="callable",
+                               params={"fn": _flaky(1, calls)},
+                               max_retries=2, retry_backoff_s=0.01))
+    assert job.wait(10)
+    assert job.state is JobState.DONE
+    assert job.attempt == 2 and len(calls) == 2
+    assert job.result["value"] == 43
+    assert job.not_before is not None        # backoff gate was armed
+    assert metrics.counter_value("serving.recovery.retries") == 1
+    assert metrics.counter_value("serving.jobs.completed") == 1
+    assert metrics.counter_value("serving.jobs.failed") == 0
+    assert job.to_wire()["attempt"] == 2
+
+
+def test_retries_exhausted_goes_failed(sched, metrics):
+    calls = []
+    job = sched.submit(JobSpec(kind="callable",
+                               params={"fn": _flaky(99, calls)},
+                               max_retries=2, retry_backoff_s=0.01))
+    assert job.wait(10)
+    assert job.state is JobState.FAILED
+    assert job.attempt == 3 and len(calls) == 3   # initial + 2 retries
+    assert "boom #3" in job.error
+    assert metrics.counter_value("serving.recovery.retries") == 2
+    assert metrics.counter_value(
+        "serving.recovery.retries_exhausted") == 1
+    assert metrics.counter_value("serving.jobs.failed") == 1
+
+
+def test_no_retry_without_budget(sched, metrics):
+    calls = []
+    job = sched.submit(JobSpec(kind="callable",
+                               params={"fn": _flaky(99, calls)}))
+    assert job.wait(10)
+    assert job.state is JobState.FAILED and job.attempt == 1
+    assert len(calls) == 1
+    assert metrics.counter_value("serving.recovery.retries") == 0
+
+
+def test_retry_backoff_spacing(sched):
+    """The second attempt must not start before the exponential backoff
+    elapses (gap can only be LARGER under load, so no flake)."""
+    calls = []
+    job = sched.submit(JobSpec(kind="callable",
+                               params={"fn": _flaky(1, calls)},
+                               max_retries=1, retry_backoff_s=0.2))
+    assert job.wait(10) and job.state is JobState.DONE
+    assert len(calls) == 2
+    assert calls[1] - calls[0] >= 0.2 * 0.9   # small clock-skew slack
+
+
+def test_cancel_while_retrying(sched, metrics):
+    calls = []
+    job = sched.submit(JobSpec(kind="callable",
+                               params={"fn": _flaky(99, calls)},
+                               max_retries=3, retry_backoff_s=30.0))
+    deadline = time.time() + 10
+    while time.time() < deadline and job.state is not JobState.RETRYING:
+        time.sleep(0.01)
+    assert job.state is JobState.RETRYING
+    assert job.to_wire()["retry_at"] > time.time()
+    assert sched.cancel(job.id)
+    assert job.state is JobState.CANCELLED
+    assert len(calls) == 1                    # backoff never elapsed
+    assert metrics.counter_value("serving.jobs.cancelled") == 1
+
+
+def test_close_fails_retrying_job_permanently(metrics):
+    sched = JobScheduler(snapshot=_tiny_snapshot(), metrics=metrics)
+    calls = []
+    job = sched.submit(JobSpec(kind="callable",
+                               params={"fn": _flaky(99, calls)},
+                               max_retries=3, retry_backoff_s=30.0))
+    deadline = time.time() + 10
+    while time.time() < deadline and job.state is not JobState.RETRYING:
+        time.sleep(0.01)
+    assert job.state is JobState.RETRYING
+    sched.close()
+    # a closing scheduler must not re-enter RETRYING: permanent FAILED
+    assert job.state is JobState.FAILED
+    assert "scheduler closed" in job.error
+    assert len(calls) == 1
+
+
+def test_exhausted_flag_not_set_by_permanent_failure(metrics):
+    """retries_exhausted must mean 'retry budget declined the retry',
+    not 'FAILED while attempt happens to exceed max_retries': a
+    close()-sweep permanent failure mid-retry does not count."""
+    sched = JobScheduler(snapshot=_tiny_snapshot(), metrics=metrics)
+    calls = []
+    job = sched.submit(JobSpec(kind="callable",
+                               params={"fn": _flaky(99, calls)},
+                               max_retries=1, retry_backoff_s=30.0))
+    deadline = time.time() + 10
+    while time.time() < deadline and job.state is not JobState.RETRYING:
+        time.sleep(0.01)
+    sched.close()                       # permanent fail on attempt 2
+    assert job.state is JobState.FAILED
+    assert not job.retries_exhausted
+    assert metrics.counter_value(
+        "serving.recovery.retries_exhausted") == 0
+
+
+def test_junk_max_levels_fails_permanently(metrics):
+    """A bfs job with unparseable max_levels is a param error: it must
+    FAIL on attempt 1, never burn its retry budget (the same contract
+    as an unresolvable source)."""
+    sched = JobScheduler(snapshot=_tiny_snapshot(), metrics=metrics)
+    try:
+        job = sched.submit(JobSpec(kind="bfs",
+                                   params={"source_dense": 0,
+                                           "max_levels": "abc"},
+                                   max_retries=3, retry_backoff_s=0.01))
+        assert job.wait(10)
+        assert job.state is JobState.FAILED and job.attempt == 1
+        assert metrics.counter_value("serving.recovery.retries") == 0
+    finally:
+        sched.close()
+
+
+def test_wire_junk_faults_value_rejected(metrics):
+    """An arbitrary params['faults'] value (e.g. from the HTTP body)
+    must be rejected at admission — inside the fused batch it would
+    fail every batchmate."""
+    sched = JobScheduler(snapshot=_tiny_snapshot(), metrics=metrics)
+    try:
+        with pytest.raises(ValueError):
+            sched.submit(JobSpec(kind="bfs",
+                                 params={"source_dense": 0,
+                                         "faults": {"crash": 2}}))
+        assert metrics.counter_value("serving.jobs.rejected") == 1
+        assert metrics.counter_value("serving.jobs.submitted") == 0
+    finally:
+        sched.close()
+
+
+def test_checkpoint_keys_namespaced_per_scheduler(tmp_path):
+    """Two schedulers (processes) sharing one checkpoint_dir must key
+    their jobs' checkpoints disjointly — job ids restart per process,
+    and resuming another scheduler's checkpoint would serve its state
+    as this job's result."""
+    s1 = JobScheduler(snapshot=_tiny_snapshot(), autostart=False,
+                      checkpoint_dir=str(tmp_path))
+    s2 = JobScheduler(snapshot=_tiny_snapshot(), autostart=False,
+                      checkpoint_dir=str(tmp_path))
+    try:
+        j1 = s1.submit(JobSpec(kind="bfs", params={"source_dense": 0},
+                               checkpoint_every=1))
+        j2 = s2.submit(JobSpec(kind="bfs", params={"source_dense": 0},
+                               checkpoint_every=1))
+        assert j1.recovery.key.endswith(j1.id)
+        assert j1.recovery.key != j1.id          # nonce-prefixed
+        ns1 = j1.recovery.key[:-len(j1.id)]
+        ns2 = j2.recovery.key[:-len(j2.id)]
+        assert ns1 != ns2
+    finally:
+        s1.close()
+        s2.close()
+
+
+# --------------------------------------------------------------------------
+# satellite: submitted-vs-rejected metrics (the submit() counter lie)
+# --------------------------------------------------------------------------
+
+def test_rejected_submits_do_not_count_as_submitted(metrics):
+    sched = JobScheduler(snapshot=_tiny_snapshot(), metrics=metrics)
+    with pytest.raises(ValueError):
+        sched.submit(JobSpec(kind="astrology"))
+    assert metrics.counter_value("serving.jobs.submitted") == 0
+    assert metrics.counter_value("serving.jobs.rejected") == 1
+    job = sched.submit(JobSpec(kind="callable",
+                               params={"fn": lambda: 1}))
+    assert job.wait(10)
+    assert metrics.counter_value("serving.jobs.submitted") == 1
+    sched.close()
+    with pytest.raises(RuntimeError):
+        sched.submit(JobSpec(kind="callable",
+                             params={"fn": lambda: 1}))
+    assert metrics.counter_value("serving.jobs.submitted") == 1
+    assert metrics.counter_value("serving.jobs.rejected") == 2
+
+
+# --------------------------------------------------------------------------
+# satellite: close-during-RUNNING — DONE must never follow FAILED
+# --------------------------------------------------------------------------
+
+def test_never_done_after_failed_on_close(metrics):
+    """close() fails a still-RUNNING job while the worker thread may
+    finish afterwards and call complete(): the terminal state must stay
+    FAILED and the terminal metrics must fire exactly once."""
+    sched = JobScheduler(snapshot=_tiny_snapshot(), metrics=metrics)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def fn():
+        entered.set()
+        release.wait(30)
+        return "late result"
+
+    job = sched.submit(JobSpec(kind="callable", params={"fn": fn}))
+    assert entered.wait(10)
+    assert job.state is JobState.RUNNING
+    sched.close(timeout=0.2)          # worker still blocked in fn()
+    assert job.state is JobState.FAILED
+    release.set()                     # the worker now finishes fn()...
+    sched._worker.join(10)
+    # ...but the completion must lose the race it already lost
+    assert job.state is JobState.FAILED
+    assert job.result is None
+    assert metrics.counter_value("serving.jobs.failed") == 1
+    assert metrics.counter_value("serving.jobs.completed") == 0
+    # latency histogram sampled exactly once too
+    assert metrics.histogram("serving.job.latency_ms").count == 1
+
+
+def test_done_and_cancel_race_is_single_terminal(sched, metrics):
+    """Direct Job-level pin: once terminal, every later transition
+    (complete / fail / retrying-fail) is a no-op."""
+    from titan_tpu.olap.serving.jobs import Job
+
+    job = Job(JobSpec(kind="callable", max_retries=5))
+    assert job.start()                    # QUEUED -> RUNNING
+    assert job.fail("dead", permanent=True)
+    assert job.state is JobState.FAILED
+    assert not job.complete({"v": 1})
+    assert job.state is JobState.FAILED and job.result is None
+    assert not job.fail("again")
+    assert job.metered_once() and not job.metered_once()

@@ -212,6 +212,36 @@ def test_refresh_leaves_future_payloads_queued(graph):
     assert list(snap._listener) == [future]       # not drained, not applied
 
 
+def test_refresh_drains_large_backlog(graph):
+    """Regression for the O(backlog²) listener drain (ISSUE r8
+    satellite): refresh() used ``q.pop(0)`` per payload, quadratic
+    against the 10k-commit backlog cap; the drain is now one scan +
+    one slice delete. A ~1.2k-commit backlog must apply completely in
+    one refresh, leave the queue empty, and keep the racing-payload
+    boundary (a future-epoch payload stays queued)."""
+    snap = snap_mod.build(graph)
+    before = snap.num_edges
+    tx = graph.new_transaction()
+    ids = [v.id for v in tx.vertices()]
+    tx.rollback()
+    n_commits = 1200
+    for i in range(n_commits):
+        tx = graph.new_transaction()
+        tx.vertex(ids[i % 6]).add_edge("link",
+                                       tx.vertex(ids[(i + 1) % 6]))
+        tx.commit()
+    q = snap._listener
+    assert len(q) == n_commits and not q.overflowed
+    future = {"epoch": graph.mutation_epoch + 1, "added": [],
+              "removed": [], "added_vertices": [], "removed_vertices": []}
+    q.append(future)
+    stats = snap.refresh()
+    assert stats["added_edges"] == n_commits
+    assert snap.num_edges == before + n_commits
+    assert snap.epoch == graph.mutation_epoch
+    assert list(q) == [future]        # boundary: future payload kept
+
+
 def test_build_retries_when_commit_races_scan(graph, monkeypatch):
     """build() must detect an epoch bump during its store scan and rescan
     (the racing commit may or may not be in the scanned rows)."""

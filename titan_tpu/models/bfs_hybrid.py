@@ -849,7 +849,9 @@ def _batched_exhaust():
 
 
 def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
-                         on_level=None, return_device: bool = False):
+                         on_level=None, return_device: bool = False,
+                         init_dist=None, start_level: int = 0,
+                         checkpoint=None):
     """Batched multi-source BFS: run K BFS jobs over the SAME graph as
     one device run with [K, n] state. Each job's ``dist`` row is
     bit-equal to ``frontier_bfs_hybrid`` from that source (BFS distances
@@ -861,6 +863,14 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
     [K]); it may return a boolean KEEP mask [K] — jobs masked out
     (cancellation, deadline, timeout) stop executing before the level's
     sweep and report ``completed=False``. Returning None keeps all.
+
+    Checkpoint plane (olap/recovery): the level-synchronous state is
+    exactly ``(dist, level)`` — the frontier is ``dist == level`` —
+    so ``checkpoint(level, dist, active)`` (dist [K, n+1] device,
+    active np bool [K]) at a level boundary captures everything, and
+    ``init_dist`` ([K, n] int32) + ``start_level`` restart the loop
+    from a captured boundary with bit-equal continuation (``sources``
+    then only sizes/validates the batch).
 
     Returns ``(dist, levels, completed)``: dist [K, n] (device array
     when ``return_device``, else numpy; INF = unreachable — partial for
@@ -892,13 +902,24 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
                 [a, jnp.full((cap_n - a.shape[0],), n + 1, a.dtype)])
         return a
 
-    dist = jnp.full((K, n + 1), INF, jnp.int32) \
-        .at[jnp.arange(K), jnp.asarray(src_arr.astype(np.int32))].set(0)
+    if init_dist is None:
+        dist = jnp.full((K, n + 1), INF, jnp.int32) \
+            .at[jnp.arange(K),
+                jnp.asarray(src_arr.astype(np.int32))].set(0)
+    else:
+        d = np.asarray(init_dist, np.int32)
+        if d.shape != (K, n):
+            raise ValueError(f"init_dist must be [K={K}, n={n}], "
+                             f"got {d.shape}")
+        # col n is the scatter pad slot; it starts (and stays) INF in a
+        # fresh run, so a resumed row re-appends it
+        dist = jnp.concatenate(
+            [jnp.asarray(d), jnp.full((K, 1), INF, jnp.int32)], axis=1)
     act_h = np.ones(K, bool)
     active = jnp.asarray(act_h)
     levels = np.zeros(K, np.int32)
     completed = np.zeros(K, bool)
-    level = 0
+    level = int(start_level)
     while level < max_levels:
         fbits, cand, stats = bplan(dist, active, dev_scalar(level), degc,
                                    c_cap=cap_n, n_=n)
@@ -922,6 +943,10 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
                     mask_changed = True
         if not act_h.any():
             break
+        if checkpoint is not None:
+            # consistent boundary: every level < ``level`` is final in
+            # dist, this level's frontier (dist == level) is unswept
+            checkpoint(level, dist, act_h.copy())
         if mask_changed:
             # deactivated jobs (completed OR dropped) must stop
             # influencing the sweep: re-plan with the new mask — it

@@ -314,7 +314,9 @@ class RoundInterrupted(Exception):
 
 def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
                   max_rounds: int, delta: float | None = None,
-                  quantile_mass: int = 0, on_round=None):
+                  quantile_mass: int = 0, on_round=None,
+                  checkpoint=None, start_rounds: int = 0,
+                  bucket_end0: float | None = None):
     """Expansion-tracked round loop: one plan readback per round
     (_band_plan — compacted in-band list + mass-balanced segment
     bounds, no n-wide nonzero), then one _push_list dispatch per
@@ -325,7 +327,17 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
     the expanded band carries ~that much chunk mass — priority-batched
     expansion in near-sorted value order. Without either, every
     improved vertex is in-band every round (threshold = the +inf
-    sentinel)."""
+    sentinel).
+
+    Checkpoint plane (olap/recovery): ``checkpoint(rounds, state)`` is
+    called at every round boundary (after the on_round veto) with the
+    COMPLETE loop state — ``{"val", "val_exp", "bucket_end",
+    "quantile_mass"}`` — and owns its own cadence; a run restarted
+    with that state via ``start_rounds`` / ``bucket_end0`` /
+    ``quantile_mass`` continues the exact trajectory (the pushes are
+    min-scatters, order-independent and exact, so the final arrays are
+    bit-equal to an uninterrupted run even if kernel-width choices
+    differ after resume)."""
     import time as _time
 
     import jax.numpy as jnp
@@ -363,8 +375,10 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
     if quantile_mass and not is_f32:
         quantile_mass = 0
     bucket_end = big if not delta or delta <= 0 else delta
+    if bucket_end0 is not None:         # resume: restored bucket state
+        bucket_end = bucket_end0
     trace = g.get("_trace_rounds")      # optional perf instrumentation:
-    rounds = 0                          # set g["_trace_rounds"] = [] to
+    rounds = int(start_rounds)          # set g["_trace_rounds"] = [] to
     dtname = "float32" if is_f32 else "int32"
     prev_sig = None                     # collect per-round 5-tuples
     # plan-cost isolation drain: opt-in SEPARATELY from the trace — it
@@ -378,6 +392,14 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
         # BFS level mask, for the single-execution kinds
         if on_round is not None and not on_round(rounds):
             raise RoundInterrupted(rounds)
+        # checkpoint capture at the same boundary: the callback owns
+        # cadence and readback; (val, val_exp) here is a CONSISTENT
+        # state — every push of earlier rounds has landed, none of this
+        # round's has started
+        if checkpoint is not None:
+            checkpoint(rounds, {"val": val, "val_exp": val_exp,
+                                "bucket_end": bucket_end,
+                                "quantile_mass": quantile_mass})
         # list width: quantile mode caps at QUANT_LIST_CAP (the band
         # carries ~quantile_mass chunks, so members are bounded and
         # truncation only defers); plain/delta modes must cover EVERY
@@ -468,10 +490,17 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
                   w_range: float = 1.0, max_rounds: int = 10_000,
                   delta: float | None = None,
                   quantile_mass: int | None = None,
-                  return_device: bool = False, on_round=None):
+                  return_device: bool = False, on_round=None,
+                  checkpoint=None, resume: dict | None = None):
     """SSSP over hashed edge weights with an expansion-tracked frontier;
     ``delta`` > 0 adds delta-stepping buckets. Returns (dist float32 [n]
     with FINF unreachable, rounds).
+
+    ``checkpoint(rounds, state)``: round-boundary state capture (see
+    ``_frontier_run``). ``resume``: a dict with ``val``/``val_exp``
+    ([n+1] float32), ``rounds``, ``bucket_end`` and ``quantile_mass``
+    from a prior checkpoint — the run continues that trajectory and
+    its final distances are bit-equal to an uninterrupted run.
 
     Default is NO buckets: on hub-dominated power-law graphs the
     shortest-path distances concentrate in a band narrower than any
@@ -496,14 +525,28 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
         # delta-stepping buckets (spread distance distributions).
         quantile_mass = 0 if delta and delta > 0 \
             else QUANTILE_MASS_DEFAULT
-    val = jnp.full((n + 1,), FINF, jnp.float32).at[source_dense].set(0.0)
-    # nothing has pushed yet: only the source reads as improved
-    # (val < val_exp); unreached vertices sit at val == val_exp == FINF
-    val_exp = jnp.full((n + 1,), FINF, jnp.float32)
+    start_rounds, bucket_end0 = 0, None
+    if resume is not None:
+        # restored checkpoint state overrides the fresh-start init AND
+        # the mode knobs that may have mutated mid-run (quantile
+        # escalation, delta bucket advance)
+        val = jnp.asarray(resume["val"], jnp.float32)
+        val_exp = jnp.asarray(resume["val_exp"], jnp.float32)
+        start_rounds = int(resume["rounds"])
+        bucket_end0 = float(resume["bucket_end"])
+        quantile_mass = int(resume["quantile_mass"])
+    else:
+        val = jnp.full((n + 1,), FINF, jnp.float32) \
+            .at[source_dense].set(0.0)
+        # nothing has pushed yet: only the source reads as improved
+        # (val < val_exp); unreached sit at val == val_exp == FINF
+        val_exp = jnp.full((n + 1,), FINF, jnp.float32)
     out, rounds = _frontier_run(g, val, val_exp, "sssp",
                                 (min_w, w_range), max_rounds,
                                 delta=delta, quantile_mass=quantile_mass,
-                                on_round=on_round)
+                                on_round=on_round, checkpoint=checkpoint,
+                                start_rounds=start_rounds,
+                                bucket_end0=bucket_end0)
     if not return_device:
         out = np.asarray(out)
     return out, rounds
@@ -535,7 +578,8 @@ def _wcc_seed_labels():
 
 def pagerank_dense(snap_or_graph, iterations: int = 20,
                    damping: float = 0.85, tol: float | None = None,
-                   return_device: bool = False, on_round=None):
+                   return_device: bool = False, on_round=None,
+                   checkpoint=None, resume: dict | None = None):
     """Push-mode PageRank over the chunked CSR via dense window sweeps:
     rank' = (1-d)/n + d * sum over in-edges of rank[src]/outdeg[src]
     (semantics match the pull-mode engine program in models/pagerank.py,
@@ -543,7 +587,13 @@ def pagerank_dense(snap_or_graph, iterations: int = 20,
     run). ``tol``: early exit when the L1 delta falls below it.
     ``on_round``: per-iteration veto (RoundInterrupted) — the serving
     layer's cancellation/timeout hook, same contract as
-    ``_frontier_run``."""
+    ``_frontier_run``.
+
+    ``checkpoint(it, {"rank": rank})``: called after each completed
+    iteration ``it`` (rank [n+1] device). ``resume``: ``{"rank", "it"}``
+    — continue from iteration ``it``; ``contrib`` is a pure elementwise
+    function of rank (same IEEE expressions as the in-loop recompute),
+    so the continuation is bit-equal to an uninterrupted run."""
     import jax.numpy as jnp
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
@@ -556,11 +606,16 @@ def pagerank_dense(snap_or_graph, iterations: int = 20,
     W = min(DENSE_WINDOW, total)
     win = _pr_window()
     fin = _pr_finish()
-    rank = jnp.full((n + 1,), 1.0 / n, jnp.float32) \
-        .at[n].set(0.0)
+    it0 = 0
+    if resume is not None:
+        rank = jnp.asarray(resume["rank"], jnp.float32)
+        it0 = int(resume["it"])
+    else:
+        rank = jnp.full((n + 1,), 1.0 / n, jnp.float32) \
+            .at[n].set(0.0)
     contrib = jnp.where(deg > 0, rank / jnp.maximum(deg, 1.0), 0.0)
-    it = 0
-    for it in range(1, iterations + 1):
+    it = it0
+    for it in range(it0 + 1, iterations + 1):
         if on_round is not None and not on_round(it - 1):
             raise RoundInterrupted(it - 1)
         acc = jnp.zeros((n + 1,), jnp.float32)
@@ -570,6 +625,8 @@ def pagerank_dense(snap_or_graph, iterations: int = 20,
             acc = win(acc, contrib, dev_scalar(w0), dstT, colowner, W=W)
         rank, contrib, delta = fin(acc, rank, deg,
                                    jnp.float32(damping), n_=n)
+        if checkpoint is not None:
+            checkpoint(it, {"rank": rank})
         if tol is not None and float(delta) < tol:
             break
     out = rank[:n]
@@ -618,12 +675,20 @@ def _pr_finish():
 
 
 def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
-                 return_device: bool = False, on_round=None):
+                 return_device: bool = False, on_round=None,
+                 checkpoint=None, resume: dict | None = None):
     """Hybrid connected components (symmetrized graphs): peel the seed
     vertex's whole component with one direction-optimized BFS, then run
     min-label propagation over the remaining components only. Returns
     (label int32 [n] = component minimum vertex id, rounds) where
-    rounds counts BFS levels + propagation rounds."""
+    rounds counts BFS levels + propagation rounds.
+
+    ``checkpoint(rounds, state)``: propagation-phase round-boundary
+    capture (the state dict additionally carries ``levels``, the BFS
+    peel's level count, so a resumed run reports the same total).
+    ``resume``: ``{"val", "val_exp", "rounds", "levels"}`` — skips the
+    BFS peel entirely and continues label propagation; final labels are
+    bit-equal to an uninterrupted run."""
     import jax.numpy as jnp
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
@@ -632,18 +697,34 @@ def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
     if n == 0:
         out = jnp.zeros((0,), jnp.int32)
         return (out if return_device else np.asarray(out)), 0
-    # seed at the max-degree vertex — on power-law graphs it anchors the
-    # giant component, so the BFS peels ~all edge mass
-    seed_v = int(np.asarray(jnp.argmax(g["deg"][:n])))
-    # max_levels=n: a truncated BFS would freeze the partially-peeled
-    # region as expanded, silently splitting its component's labels
-    dist, levels = frontier_bfs_hybrid(g, seed_v, max_levels=n,
-                                       return_device=True)
-    # frontier_bfs_hybrid returns dist[:n]; the seeding jit re-appends
-    # nothing — it only reads [:n_]
-    val, val_exp = _wcc_seed_labels()(dist, n_=n)
+    start_rounds = 0
+    if resume is not None:
+        val = jnp.asarray(resume["val"], jnp.int32)
+        val_exp = jnp.asarray(resume["val_exp"], jnp.int32)
+        start_rounds = int(resume["rounds"])
+        levels = int(resume.get("levels", 0))
+    else:
+        # seed at the max-degree vertex — on power-law graphs it anchors
+        # the giant component, so the BFS peels ~all edge mass
+        seed_v = int(np.asarray(jnp.argmax(g["deg"][:n])))
+        # max_levels=n: a truncated BFS would freeze the partially-peeled
+        # region as expanded, silently splitting its component's labels
+        dist, levels = frontier_bfs_hybrid(g, seed_v, max_levels=n,
+                                           return_device=True)
+        # frontier_bfs_hybrid returns dist[:n]; the seeding jit
+        # re-appends nothing — it only reads [:n_]
+        val, val_exp = _wcc_seed_labels()(dist, n_=n)
+    if checkpoint is not None:
+        _ck = checkpoint
+
+        def checkpoint(rounds, state, _ck=_ck, _levels=levels):
+            state = dict(state)
+            state["levels"] = _levels
+            _ck(rounds, state)
     out, rounds = _frontier_run(g, val, val_exp, "wcc", (0.0, 0.0),
-                                max_rounds, on_round=on_round)
+                                max_rounds, on_round=on_round,
+                                checkpoint=checkpoint,
+                                start_rounds=start_rounds)
     if not return_device:
         out = np.asarray(out)
     return out, rounds + levels

@@ -269,7 +269,17 @@ class JobSpec:
     runs against (SnapshotPool parameters; ``directed=False``
     symmetrizes, which the direction-optimizing BFS kernels require).
     For 'dense' jobs the scheduler derives ``edge_keys`` from the
-    program's ``edge_keys()`` when unset."""
+    program's ``edge_keys()`` when unset.
+
+    Recovery plane (olap/recovery): ``max_retries`` lets a RUNNING job
+    that dies (worker exception, injected fault, snapshot eviction)
+    requeue as RETRYING — with exponential backoff starting at
+    ``retry_backoff_s`` — up to that many extra attempts before FAILED;
+    ``checkpoint_every > 0`` (with a scheduler-level
+    ``checkpoint_dir``) captures the program state every N round
+    boundaries so a retried attempt resumes from the newest valid
+    checkpoint instead of restarting, bit-equal to an uninterrupted
+    run. Cancellation, timeout and param errors never retry."""
 
     kind: str
     params: dict = field(default_factory=dict)
@@ -279,6 +289,9 @@ class JobSpec:
     labels: Optional[Sequence[str]] = None
     edge_keys: Sequence[str] = ()
     directed: bool = False
+    max_retries: int = 0
+    checkpoint_every: int = 0
+    retry_backoff_s: float = 0.05
 
 
 class DenseProgram(abc.ABC):

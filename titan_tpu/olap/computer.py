@@ -159,13 +159,30 @@ class HostGraphComputer:
 
     def run(self, program: VertexProgram, max_iterations: int = 100,
             write_back: bool = False,
-            map_reduces: Optional[list] = None) -> HostComputerResult:
+            map_reduces: Optional[list] = None, *,
+            checkpoint=None, checkpoint_every: int = 0,
+            resume: Optional[dict] = None) -> HostComputerResult:
+        """Run a host BSP program; optionally through the checkpoint
+        plane (olap/recovery): ``checkpoint(iteration, payload)`` fires
+        every ``checkpoint_every`` completed supersteps with the FULL
+        host state (vertex states + pending messages + global memory —
+        Python objects; the store persists them as a digest-checked
+        pickle payload), and ``resume`` restores such a payload to
+        continue the superstep loop. Host programs run per-vertex
+        callbacks in a thread pool, so unlike the device kernels the
+        continuation is deterministic only if the program's message
+        combining is order-independent."""
         # validate BEFORE the expensive BSP loop
         _check_map_reduces(map_reduces, require=MapReduce)
         memory = Memory()
         vm = VertexMemory(program.combiner())
         program.setup(memory)
         iterations = 0
+        if resume is not None:
+            vm._state = dict(resume["states"])
+            vm._incoming = dict(resume["messages"])
+            memory._values = dict(resume["memory"])
+            iterations = int(resume["iteration"])
         while True:
             memory.iteration = iterations
             tx = self.graph.new_transaction(read_only=True)
@@ -179,7 +196,16 @@ class HostGraphComputer:
                 tx.rollback()
             vm.complete_iteration()
             iterations += 1
-            if program.terminate(memory) or iterations >= max_iterations:
+            terminated = (program.terminate(memory)
+                          or iterations >= max_iterations)
+            if (checkpoint is not None and checkpoint_every > 0
+                    and not terminated
+                    and iterations % checkpoint_every == 0):
+                checkpoint(iterations, {"states": vm.all_states(),
+                                        "messages": vm._incoming,
+                                        "memory": memory._values,
+                                        "iteration": iterations})
+            if terminated:
                 break
         # MapReduce stages over the final vertex states (reference:
         # FulgoraGraphComputer.java:192-246)

@@ -1,0 +1,38 @@
+"""Superstep checkpoint & recovery plane for device-resident OLAP jobs.
+
+Long vertex-program runs (scale-26 BFS, multi-round SSSP/WCC, 50+
+-iteration PageRank) on preemptible accelerators are all-or-nothing
+without this plane: a worker crash, HBM eviction race, or host
+preemption loses the whole run. This package rebuilds Pregel's
+superstep-boundary checkpointing (Malewicz et al., SIGMOD 2010 §4.2 —
+the canonical BSP fault-tolerance design behind the reference's
+Fulgora/VertexProgram contract) on top of the round-boundary hooks the
+serving layer already owns (``on_round`` / ``on_level`` vetoes):
+
+* ``store``      — versioned on-disk checkpoints: per-array sha256
+                   digests in a manifest, atomic rename-commit, newest-
+                   valid-wins ``latest()`` (a torn or corrupted
+                   checkpoint is detected and skipped, never adopted).
+* ``checkpoint`` — ``JobRecovery``: per-job cadence + fault binding the
+                   batcher drives from the round hooks, with
+                   ``serving.recovery.*`` metrics.
+* ``faults``     — deterministic injector (crash-at-round-k, corrupt-
+                   checkpoint, slow-write, snapshot-evicted-mid-job)
+                   the test matrix uses to drive every recovery path
+                   without flakiness.
+
+Deterministic resume: the round loops are data-deterministic, so a run
+crashed at round k and resumed from its newest checkpoint produces
+final arrays BIT-EQUAL to an uninterrupted run (property-tested for
+BFS, SSSP, WCC and PageRank in tests/test_recovery.py). The scheduler
+side (RETRYING state, exponential backoff, retry exhaustion) lives in
+olap/serving; docs/recovery.md documents the contract.
+"""
+
+from titan_tpu.olap.recovery.checkpoint import JobRecovery       # noqa: F401
+from titan_tpu.olap.recovery.faults import (FaultPlan,           # noqa: F401
+                                            InjectedFault,
+                                            SnapshotEvicted)
+from titan_tpu.olap.recovery.store import (Checkpoint,           # noqa: F401
+                                           CheckpointInvalid,
+                                           CheckpointStore)

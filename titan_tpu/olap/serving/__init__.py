@@ -21,7 +21,11 @@ admission-controlled asynchronous job plane over the TPU engine:
                   through utils/metrics.
 
 ``server.py`` exposes this as ``POST /jobs`` / ``GET /jobs/<id>`` /
-``DELETE /jobs/<id>``; docs/serving.md documents the contract.
+``DELETE /jobs/<id>``; docs/serving.md documents the contract. The
+checkpoint & recovery plane (preemption-safe jobs: RETRYING + backoff
+requeue + deterministic resume from superstep checkpoints) lives in
+``olap/recovery`` and plugs in through ``JobScheduler(checkpoint_dir=)``
++ ``JobSpec.max_retries`` / ``checkpoint_every``; docs/recovery.md.
 """
 
 from titan_tpu.olap.serving.jobs import Job, JobState            # noqa: F401

@@ -100,25 +100,61 @@ class Batcher:
         job's row is bit-equal to a sequential single-source run. Jobs
         whose source does not resolve fail up front (they never join the
         batch); cancellation/timeout drop individual jobs at level
-        boundaries via the kernel's keep mask."""
+        boundaries via the kernel's keep mask.
+
+        Recovery plane: a retry attempt with a valid checkpoint resumes
+        SOLO (its level counter differs from any fresh batchmate, and
+        the batched kernel runs ONE shared level loop); fresh jobs — and
+        retries restarting clean — fuse as usual. Checkpoints capture
+        each active job's dist row at its cadence; an injected fault
+        raising out of a level boundary fails the WHOLE batch (that is
+        what a real worker death does), and each member then retries
+        under its own policy."""
+        fresh: list[Job] = []
+        fresh_src: list[int] = []
+        resumed: list[tuple[Job, int, object]] = []
+        for job in jobs:
+            try:
+                src = _dense_source(snap, job.spec.params)
+                # junk max_levels is a param error too — it must fail
+                # permanently HERE, not detonate retryably mid-group
+                int(job.spec.params.get("max_levels", 1000))
+            except (KeyError, ValueError, TypeError) as e:
+                # param errors are permanent: retrying cannot fix them
+                job.fail(f"{type(e).__name__}: {e}", permanent=True)
+                continue
+            ck = None
+            rec = job.recovery
+            if rec is not None and job.attempt > 1:
+                ck = rec.latest(kind="bfs",
+                                epoch=getattr(snap, "epoch", None))
+                if ck is not None:
+                    rec.resumed(ck.round)
+                else:
+                    rec.restarted()
+            if ck is not None:
+                resumed.append((job, src, ck))
+            else:
+                fresh.append(job)
+                fresh_src.append(src)
+        if fresh:
+            self._bfs_group(fresh, fresh_src, snap, None, 0)
+        for job, src, ck in resumed:
+            self._bfs_group([job], [src], snap,
+                            np.asarray(ck.arrays["dist"])[None, :],
+                            ck.round)
+
+    def _bfs_group(self, runnable: list[Job], sources: list[int], snap,
+                   init_dist, start_level: int) -> None:
         from titan_tpu.models.bfs import INF
         from titan_tpu.models.bfs_hybrid import frontier_bfs_batched
 
-        runnable: list[Job] = []
-        sources: list[int] = []
-        for job in jobs:
-            try:
-                sources.append(_dense_source(snap, job.spec.params))
-                runnable.append(job)
-            except (KeyError, ValueError) as e:
-                job.fail(f"{type(e).__name__}: {e}")
-        if not runnable:
-            return
         K = len(runnable)
         for job in runnable:
             job.batch_k = K
         started = time.time()
         dropped = [None] * K    # terminal state decided at a boundary
+        n = snap.n if hasattr(snap, "n") else snap["n"]
 
         def on_level(level, nf):
             keep = np.ones(K, bool)
@@ -127,6 +163,12 @@ class Batcher:
                 if dropped[i] is not None:
                     keep[i] = False
                     continue
+                job.last_round = level
+                rec = job.recovery
+                if rec is not None and rec.faults is not None:
+                    # deterministic fault injection (tests): raising
+                    # here kills the batch, like a real worker death
+                    rec.faults.check(level, job.attempt, snap)
                 if job.cancel_requested:
                     dropped[i] = "cancel"
                     keep[i] = False
@@ -136,11 +178,25 @@ class Batcher:
                     keep[i] = False
             return keep if not keep.all() else None
 
+        def checkpoint(level, dist, act):
+            for i, job in enumerate(runnable):
+                rec = job.recovery
+                if rec is not None and act[i] and rec.due(level):
+                    rec.save(level,
+                             {"dist": np.asarray(dist[i, :n])},
+                             kind="bfs",
+                             meta={"epoch": getattr(snap, "epoch", None)})
+
+        wants_ckpt = any(j.recovery is not None
+                         and j.recovery.store is not None
+                         for j in runnable)
         try:
             dist, levels, completed = frontier_bfs_batched(
                 snap, sources, max_levels=int(
                     runnable[0].spec.params.get("max_levels", 1000)),
-                on_level=on_level)
+                on_level=on_level,
+                init_dist=init_dist, start_level=start_level,
+                checkpoint=checkpoint if wants_ckpt else None)
         except Exception as e:
             for job in runnable:
                 job.fail(f"{type(e).__name__}: {e}")
@@ -162,14 +218,23 @@ class Batcher:
         frontier kinds honor cancellation/timeout at ROUND boundaries
         through ``_frontier_run``'s on_round veto (models/frontier
         RoundInterrupted) — the single-execution analog of the batched
-        kernel's level mask."""
+        kernel's level mask. The same boundaries drive the recovery
+        plane (job.recovery): fault injection, checkpoint capture at
+        the job's cadence, and — on a retry attempt — resume from the
+        newest valid checkpoint (epoch-matched; otherwise clean
+        restart). Param errors fail permanently (no retry)."""
         job.batch_k = 1
         kind = job.spec.kind
         params = dict(job.spec.params)
+        params.pop("faults", None)       # injector is not a kernel param
+        rec = job.recovery
         started = time.time()
         interrupted = {}
 
         def on_round(rounds):
+            job.last_round = rounds
+            if rec is not None and rec.faults is not None:
+                rec.faults.check(rounds, job.attempt, snap)
             if job.cancel_requested:
                 interrupted["why"] = "cancel"
                 return False
@@ -179,34 +244,102 @@ class Batcher:
                 return False
             return True
 
+        if kind == "bfs":
+            # bfs delegates wholesale — run_bfs_batch owns its own
+            # resume bookkeeping (doing it here too would double-count
+            # serving.recovery.resumes / rounds_replayed)
+            self.run_bfs_batch([job], snap)
+            return
+        epoch = getattr(snap, "epoch", None)
+        ck = None
+        if rec is not None and job.attempt > 1 and kind != "callable":
+            ck = rec.latest(kind=kind, epoch=epoch)
+            if ck is not None:
+                rec.resumed(ck.round)
+            else:
+                rec.restarted()
+        wants_ckpt = rec is not None and rec.store is not None
+
         try:
-            if kind == "bfs":
-                self.run_bfs_batch([job], snap)
-                return
             if kind == "sssp":
                 from titan_tpu.models.frontier import FINF, frontier_sssp
-                src = _dense_source(snap, params)
+                try:
+                    src = _dense_source(snap, params)
+                except (KeyError, ValueError) as e:
+                    job.fail(f"{type(e).__name__}: {e}", permanent=True)
+                    return
+                ckpt = None
+                if wants_ckpt:
+                    def ckpt(rounds, state):
+                        if rec.due(rounds):
+                            rec.save(rounds,
+                                     {"val": np.asarray(state["val"]),
+                                      "val_exp":
+                                          np.asarray(state["val_exp"])},
+                                     kind="sssp",
+                                     meta={"epoch": epoch,
+                                           "bucket_end":
+                                               float(state["bucket_end"]),
+                                           "quantile_mass":
+                                               int(state["quantile_mass"])})
+                resume = None
+                if ck is not None:
+                    resume = {"val": ck.arrays["val"],
+                              "val_exp": ck.arrays["val_exp"],
+                              "rounds": ck.round,
+                              "bucket_end": ck.meta["bucket_end"],
+                              "quantile_mass": ck.meta["quantile_mass"]}
                 dist, rounds = frontier_sssp(
                     snap, src,
                     delta=params.get("delta"),
                     quantile_mass=params.get("quantile_mass"),
                     max_rounds=int(params.get("max_rounds", 10_000)),
-                    on_round=on_round)
+                    on_round=on_round, checkpoint=ckpt, resume=resume)
                 dist = np.asarray(dist)
                 job.complete({"rounds": int(rounds),
                               "reached": int((dist < float(FINF)).sum()),
                               "dist": dist})
             elif kind == "pagerank":
                 from titan_tpu.models.frontier import pagerank_dense
+                ckpt = None
+                if wants_ckpt:
+                    def ckpt(it, state):
+                        if rec.due(it):
+                            rec.save(it,
+                                     {"rank": np.asarray(state["rank"])},
+                                     kind="pagerank",
+                                     meta={"epoch": epoch})
+                resume = None
+                if ck is not None:
+                    resume = {"rank": ck.arrays["rank"], "it": ck.round}
                 rank, iters = pagerank_dense(
                     snap, iterations=int(params.get("iterations", 20)),
                     damping=float(params.get("damping", 0.85)),
-                    tol=params.get("tol"), on_round=on_round)
+                    tol=params.get("tol"), on_round=on_round,
+                    checkpoint=ckpt, resume=resume)
                 job.complete({"iterations": int(iters),
                               "rank": np.asarray(rank)})
             elif kind == "wcc":
                 from titan_tpu.models.frontier import frontier_wcc
-                lab, rounds = frontier_wcc(snap, on_round=on_round)
+                ckpt = None
+                if wants_ckpt:
+                    def ckpt(rounds, state):
+                        if rec.due(rounds):
+                            rec.save(rounds,
+                                     {"val": np.asarray(state["val"]),
+                                      "val_exp":
+                                          np.asarray(state["val_exp"])},
+                                     kind="wcc",
+                                     meta={"epoch": epoch,
+                                           "levels": int(state["levels"])})
+                resume = None
+                if ck is not None:
+                    resume = {"val": ck.arrays["val"],
+                              "val_exp": ck.arrays["val_exp"],
+                              "rounds": ck.round,
+                              "levels": ck.meta.get("levels", 0)}
+                lab, rounds = frontier_wcc(snap, on_round=on_round,
+                                           checkpoint=ckpt, resume=resume)
                 lab = np.asarray(lab)
                 job.complete({"rounds": int(rounds),
                               "components": int(len(np.unique(lab))),
@@ -214,13 +347,38 @@ class Batcher:
             elif kind == "dense":
                 from titan_tpu.olap.tpu.engine import run_single
                 program = params.pop("program")
-                res = run_single(program, snap, params)
+                ckpt = None
+                every = 0
+                if rec is not None and (wants_ckpt
+                                        or rec.faults is not None):
+                    # dense programs have no on_round veto; the chunk
+                    # boundary is the only host hook, so faults fire
+                    # here — and a fault plan WITHOUT a store still
+                    # needs the chunked loop (every=1) to get hooks
+                    every = rec.every if wants_ckpt else 1
+
+                    def ckpt(it, state):
+                        job.last_round = it
+                        if rec.faults is not None:
+                            rec.faults.check(it, job.attempt, snap)
+                        if wants_ckpt and rec.due(it):
+                            rec.save(it,
+                                     {k: np.asarray(v)
+                                      for k, v in state.items()},
+                                     kind="dense",
+                                     meta={"epoch": epoch})
+                resume = None
+                if ck is not None:
+                    resume = {"state": ck.arrays, "iteration": ck.round}
+                res = run_single(
+                    program, snap, params, resume=resume, checkpoint=ckpt,
+                    checkpoint_every=every)
                 job.complete({"iterations": res.iterations,
                               **{k: np.asarray(v) for k, v in res.items()}})
             elif kind == "callable":
                 job.complete({"value": params["fn"]()})
             else:
-                job.fail(f"unknown job kind {kind!r}")
+                job.fail(f"unknown job kind {kind!r}", permanent=True)
         except Exception as e:
             from titan_tpu.models.frontier import RoundInterrupted
             if isinstance(e, RoundInterrupted):

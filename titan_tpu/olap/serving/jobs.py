@@ -5,16 +5,26 @@ server serializes over the wire: spec + state machine + result/error +
 timing fields. States:
 
     QUEUED ──► RUNNING ──► DONE
-       │          │    ├──► FAILED      (exception, admission rejection)
-       │          │    ├──► TIMEOUT     (ran past spec.timeout_s)
-       │          └────┴──► CANCELLED   (DELETE while running — the
-       │                                 batched kernel drops the job at
-       │                                 the next level boundary)
-       ├──► CANCELLED                   (DELETE while queued)
-       └──► EXPIRED                     (spec.deadline passed before start)
+       │        │ ▲   ├──► FAILED      (exception / admission rejection
+       │        │ │   │                 with no retry budget left)
+       │        │ │   ├──► TIMEOUT     (ran past spec.timeout_s)
+       │        │ │   ├──► CANCELLED   (DELETE while running — the
+       │        │ │   │                 batched kernel drops the job at
+       │        │ │   │                 the next level boundary)
+       │        ▼ │   │
+       │      RETRYING─┴──► CANCELLED  (recovery plane: a retryable
+       │       (requeued with backoff;  failure with attempts left —
+       │        resumes from its        see olap/recovery; DELETE while
+       │        newest checkpoint)      RETRYING cancels immediately)
+       ├──► CANCELLED                  (DELETE while queued)
+       └──► EXPIRED                    (spec.deadline passed before start)
 
-Terminal transitions are idempotent-guarded under a lock (a cancel
-racing completion keeps whichever landed first) and release ``wait()``.
+RETRYING is NON-terminal: ``wait()`` keeps blocking, the scheduler
+requeues the job after its backoff (``Job.not_before``) and the next
+attempt resumes from the newest valid checkpoint. Terminal transitions
+are idempotent-guarded under a lock (a cancel racing completion keeps
+whichever landed first) and release ``wait()``; a job can therefore
+never go DONE after FAILED (pinned by tests/test_serving_recovery.py).
 """
 
 from __future__ import annotations
@@ -31,6 +41,7 @@ from titan_tpu.olap.api import JobSpec
 class JobState(enum.Enum):
     QUEUED = "queued"
     RUNNING = "running"
+    RETRYING = "retrying"
     DONE = "done"
     FAILED = "failed"
     TIMEOUT = "timeout"
@@ -39,7 +50,8 @@ class JobState(enum.Enum):
 
     @property
     def terminal(self) -> bool:
-        return self not in (JobState.QUEUED, JobState.RUNNING)
+        return self not in (JobState.QUEUED, JobState.RUNNING,
+                            JobState.RETRYING)
 
 
 _ids = itertools.count(1)
@@ -61,6 +73,16 @@ class Job:
         self.submitted_at = time.time()
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # recovery plane (olap/recovery): attempt counter, backoff gate,
+        # round progress + checkpoint bookkeeping; ``recovery`` is the
+        # scheduler-attached JobRecovery (None when disabled)
+        self.attempt: int = 1
+        self.not_before: Optional[float] = None
+        self.retries_exhausted: bool = False
+        self.last_round: int = 0
+        self.rounds_replayed: int = 0
+        self.checkpoint_round: Optional[int] = None
+        self.recovery = None
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._cancel = threading.Event()
@@ -93,18 +115,45 @@ class Job:
         return True
 
     def start(self) -> bool:
-        """QUEUED → RUNNING (False if the job went terminal first)."""
+        """QUEUED/RETRYING → RUNNING (False if the job went terminal
+        first). ``started_at`` keeps the FIRST start so queue latency
+        is measured once."""
         with self._lock:
-            if self.state is not JobState.QUEUED:
+            if self.state not in (JobState.QUEUED, JobState.RETRYING):
                 return False
             self.state = JobState.RUNNING
-            self.started_at = time.time()
+            if self.started_at is None:
+                self.started_at = time.time()
         return True
 
     def complete(self, result: dict) -> bool:
         return self._finish(JobState.DONE, result=result)
 
-    def fail(self, error: str) -> bool:
+    def fail(self, error: str, *, permanent: bool = False) -> bool:
+        """Record a failure. A RUNNING job with retry budget left
+        (``spec.max_retries``) transitions to RETRYING instead of
+        FAILED — attempt bumps, ``not_before`` gates the requeue with
+        exponential backoff, and ``wait()`` keeps blocking; the
+        scheduler requeues it and the next attempt resumes from the
+        newest checkpoint. ``permanent=True`` (param errors, scheduler
+        shutdown) skips retry and goes straight to FAILED."""
+        with self._lock:
+            if self.state.terminal:
+                return False
+            if not permanent and self.state is JobState.RUNNING \
+                    and self.spec.max_retries > 0:
+                if self.attempt <= self.spec.max_retries:
+                    self.state = JobState.RETRYING
+                    self.error = error
+                    self.not_before = time.time() + \
+                        self.spec.retry_backoff_s \
+                        * (2 ** (self.attempt - 1))
+                    self.attempt += 1
+                    return True
+                # it is THIS branch declining the retry that means
+                # "budget exhausted" — a later permanent failure (param
+                # error, scheduler close) must not read as exhaustion
+                self.retries_exhausted = True
         return self._finish(JobState.FAILED, error=error)
 
     def expire(self) -> bool:
@@ -166,7 +215,16 @@ class Job:
             "started_at": self.started_at,
             "finished_at": self.finished_at,
             "batch_k": self.batch_k,
+            "attempt": self.attempt,
         }
+        if self.spec.max_retries:
+            out["max_retries"] = self.spec.max_retries
+        if self.checkpoint_round is not None:
+            out["checkpoint_round"] = self.checkpoint_round
+        if self.rounds_replayed:
+            out["rounds_replayed"] = self.rounds_replayed
+        if self.state is JobState.RETRYING and self.not_before is not None:
+            out["retry_at"] = self.not_before
         q, e = self.queue_seconds(), self.exec_seconds()
         if q is not None:
             out["queue_ms"] = round(q * 1e3, 3)

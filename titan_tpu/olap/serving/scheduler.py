@@ -14,14 +14,26 @@ residency, not thread-bound):
   the group to the Batcher;
 * cancellation (queued: immediate; running: level-boundary early-exit),
   deadlines (EXPIRED before start) and timeouts are job-level paths, so
-  one stuck caller never wedges the queue.
+  one stuck caller never wedges the queue;
+* recovery (olap/recovery, ``checkpoint_dir=``): a RUNNING job that
+  dies retryably goes RETRYING (Job.fail), requeues after its
+  exponential backoff gate (``Job.not_before`` — deferred entries stay
+  heap-resident and are skipped until due), and its next attempt
+  resumes from the newest valid checkpoint; retries exhausted → FAILED.
 
 Metrics (utils/metrics.MetricManager):
   serving.jobs.{submitted,completed,failed,cancelled,expired,timeout}
+  serving.jobs.rejected          (submits refused by admission — closed
+                                  scheduler / unknown kind; NOT counted
+                                  as submitted)
   serving.queue.depth            (counter, inc on enqueue / dec on pop)
   serving.job.latency_ms         (histogram: submit → terminal, p50/p95)
   serving.job.queue_ms           (histogram: submit → start)
   serving.batch.occupancy        (histogram: K per executed batch)
+  serving.recovery.checkpoints / .checkpoint_bytes / .checkpoint_ms
+  serving.recovery.invalid_checkpoints (digest-rejected at resume)
+  serving.recovery.resumes / .rounds_replayed
+  serving.recovery.retries / .retries_exhausted
 """
 
 from __future__ import annotations
@@ -54,12 +66,26 @@ class JobScheduler:
     def __init__(self, graph=None, snapshot=None, *, max_batch: int = 16,
                  hbm_budget_bytes: float = DEFAULT_BUDGET_BYTES,
                  metrics: Optional[MetricManager] = None,
-                 autostart: bool = True):
+                 autostart: bool = True,
+                 checkpoint_dir: Optional[str] = None):
         self.pool = SnapshotPool(graph, snapshot)
         self.ledger = HBMLedger(hbm_budget_bytes, on_evict=self._evict)
         self.batcher = Batcher(max_batch=max_batch)
         self.max_batch = max_batch
         self._metrics = metrics or MetricManager.instance()
+        # recovery plane: one store for every job's checkpoints, keyed
+        # by a per-scheduler nonce + job id (job ids restart at job-1
+        # per process while the store persists on disk — a restarted
+        # server must never resume an OLD process's checkpoint for an
+        # unrelated job); None disables capture, retries restart clean
+        self.ckpt_store = None
+        if checkpoint_dir is not None:
+            import uuid
+
+            from titan_tpu.olap.recovery import CheckpointStore
+            self.ckpt_store = CheckpointStore(checkpoint_dir,
+                                              metrics=self._metrics)
+            self._ckpt_ns = uuid.uuid4().hex[:12]
         self._jobs: dict[str, Job] = {}
         self._heap: list = []
         self._seq = itertools.count()
@@ -95,9 +121,10 @@ class JobScheduler:
         if self._worker is not None:
             self._worker.join(timeout)
         # queued jobs fail loudly rather than hang their waiters
+        # (permanent: a closing scheduler must not re-enter RETRYING)
         for job in self.jobs():
             if not job.state.terminal:
-                job.fail("scheduler closed")
+                job.fail("scheduler closed", permanent=True)
                 self._finalize_metrics(job)
         self.pool.close()
 
@@ -119,12 +146,38 @@ class JobScheduler:
     # -- submission surface --------------------------------------------------
 
     def submit(self, spec: JobSpec) -> Job:
+        # rejected submits must NOT count as submitted (the counter
+        # moves only after admission): unknown kinds and closed-
+        # scheduler refusals are serving.jobs.rejected instead
         if spec.kind not in _KNOWN_KINDS:
+            self._metrics.counter("serving.jobs.rejected").inc()
             raise ValueError(f"unknown job kind {spec.kind!r} "
                              f"(known: {', '.join(_KNOWN_KINDS)})")
+        faults = spec.params.get("faults") \
+            if isinstance(spec.params, dict) else None
+        if faults is not None:
+            from titan_tpu.olap.recovery import FaultPlan
+            if not isinstance(faults, FaultPlan):
+                # an arbitrary wire value here would detonate inside
+                # the fused batch's level callback and fail every
+                # batchmate — reject it at admission instead
+                self._metrics.counter("serving.jobs.rejected").inc()
+                raise ValueError("params['faults'] must be a "
+                                 "recovery.FaultPlan (test harness "
+                                 "only, not wire-settable)")
         job = Job(spec)
-        self._metrics.counter("serving.jobs.submitted").inc()
+        store = self.ckpt_store \
+            if self.ckpt_store is not None and spec.checkpoint_every > 0 \
+            else None
+        if store is not None or faults is not None:
+            from titan_tpu.olap.recovery import JobRecovery
+            job.recovery = JobRecovery(
+                store, job, every=spec.checkpoint_every, faults=faults,
+                metrics=self._metrics,
+                key=f"{self._ckpt_ns}-{job.id}" if store is not None
+                else None)
         if spec.deadline is not None and time.time() > spec.deadline:
+            self._metrics.counter("serving.jobs.submitted").inc()
             job.expire()
             self._finalize_metrics(job)
             with self._cv:
@@ -132,16 +185,25 @@ class JobScheduler:
             return job
         with self._cv:
             if self._stop:
+                self._metrics.counter("serving.jobs.rejected").inc()
                 raise RuntimeError("scheduler is closed")
+            self._metrics.counter("serving.jobs.submitted").inc()
             self._jobs[job.id] = job
-            heapq.heappush(self._heap,
-                           (-spec.priority,
-                            spec.deadline if spec.deadline is not None
-                            else float("inf"),
-                            next(self._seq), job))
-            self._metrics.counter("serving.queue.depth").inc()
-            self._cv.notify()
+            self._push_locked(job)
         return job
+
+    def _push_locked(self, job: Job) -> None:
+        """Heap insert (priority desc, deadline asc, FIFO) + depth/
+        notify — under the cv lock; shared by submit() and _requeue()
+        so the ordering key has exactly one definition."""
+        heapq.heappush(self._heap,
+                       (-job.spec.priority,
+                        job.spec.deadline
+                        if job.spec.deadline is not None
+                        else float("inf"),
+                        next(self._seq), job))
+        self._metrics.counter("serving.queue.depth").inc()
+        self._cv.notify()
 
     def get(self, job_id: str) -> Optional[Job]:
         with self._cv:
@@ -151,7 +213,8 @@ class JobScheduler:
         job = self.get(job_id)
         if job is None:
             return False
-        was_queued = job.state is JobState.QUEUED
+        # RETRYING cancels like QUEUED: immediately, off the worker path
+        was_queued = job.state in (JobState.QUEUED, JobState.RETRYING)
         ok = job.cancel()
         if ok and was_queued and job.state is JobState.CANCELLED:
             self._finalize_metrics(job)
@@ -164,7 +227,8 @@ class JobScheduler:
     def stats(self) -> dict:
         with self._cv:
             depth = sum(1 for *_x, j in self._heap
-                        if j.state is JobState.QUEUED)
+                        if j.state in (JobState.QUEUED,
+                                       JobState.RETRYING))
             running = self._running_batch
             jobs = list(self._jobs.values())
         by_state: dict = {}
@@ -190,24 +254,35 @@ class JobScheduler:
             return
         name = self._STATE_COUNTER[job.state]
         self._metrics.counter(f"serving.jobs.{name}").inc()
+        if job.retries_exhausted:
+            self._metrics.counter(
+                "serving.recovery.retries_exhausted").inc()
         if job.finished_at is not None:
             self._metrics.histogram("serving.job.latency_ms").update(
                 (job.finished_at - job.submitted_at) * 1e3)
 
     def _pop_group(self) -> list[Job]:
         """Under the cv lock: pop the head runnable job + compatible
-        batchmates; drop cancelled/expired entries on the way."""
+        batchmates; drop cancelled/expired entries on the way. RETRYING
+        entries are runnable but gated by their backoff (``not_before``)
+        — not-yet-due ones go back on the heap untouched."""
         group: list[Job] = []
         leftovers: list = []
         key = None
         while self._heap:
             entry = heapq.heappop(self._heap)
             job = entry[3]
-            if job.state is not JobState.QUEUED:
+            if job.state not in (JobState.QUEUED, JobState.RETRYING):
                 self._metrics.counter("serving.queue.depth").inc(-1)
                 continue       # cancelled while queued (already terminal)
-            if job.spec.deadline is not None and \
+            if job.not_before is not None and time.time() < job.not_before:
+                leftovers.append(entry)    # backoff not elapsed
+                continue
+            if job.state is JobState.QUEUED and \
+                    job.spec.deadline is not None and \
                     time.time() > job.spec.deadline:
+                # start-deadline applies to the FIRST start only: a
+                # RETRYING job already met it
                 self._metrics.counter("serving.queue.depth").inc(-1)
                 if job.expire():
                     self._finalize_metrics(job)
@@ -230,6 +305,20 @@ class JobScheduler:
             heapq.heappush(self._heap, entry)
         return group
 
+    def _requeue(self, job: Job) -> None:
+        """Put a RETRYING job back on the heap (its ``not_before``
+        backoff gate keeps _pop_group from re-running it early). Under
+        a closing scheduler the close() sweep fails it instead. The
+        state is re-checked here so a cancel landing between the
+        worker's RETRYING check and this call neither requeues a
+        terminal job nor counts a phantom retry."""
+        with self._cv:
+            if job.state is not JobState.RETRYING:
+                self._finalize_metrics(job)
+                return
+            self._metrics.counter("serving.recovery.retries").inc()
+            self._push_locked(job)
+
     def _run(self) -> None:
         while True:
             with self._cv:
@@ -240,6 +329,10 @@ class JobScheduler:
                 group = self._pop_group()
                 if group:
                     self._running_batch = len(group)
+                else:
+                    # heap holds only backoff-deferred entries: idle
+                    # briefly instead of spinning on the pop
+                    self._cv.wait(0.05)
             if not group:
                 continue
             try:
@@ -255,7 +348,10 @@ class JobScheduler:
                 with self._cv:
                     self._running_batch = 0
             for job in group:
-                self._finalize_metrics(job)
+                if job.state is JobState.RETRYING:
+                    self._requeue(job)
+                else:
+                    self._finalize_metrics(job)
 
     def _execute(self, group: list[Job]) -> None:
         head = group[0]
@@ -266,9 +362,12 @@ class JobScheduler:
         if not group:
             return
         for job in group:
+            first_start = job.started_at is None
             job.start()
             q = job.queue_seconds()
-            if q is not None:
+            # retry attempts keep the FIRST start time: sample the
+            # submit->start latency once per job, not once per attempt
+            if q is not None and first_start:
                 self._metrics.histogram("serving.job.queue_ms").update(
                     q * 1e3)
         self._metrics.histogram("serving.batch.occupancy").update(

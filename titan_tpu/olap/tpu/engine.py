@@ -32,6 +32,11 @@ from titan_tpu.ops.segment import combine_identity, segment_combine
 from titan_tpu.parallel.mesh import VERTEX_AXIS, vertex_mesh
 from titan_tpu.parallel.partition import ShardedCSR, shard_csr
 
+#: store job-id under which TPUGraphComputer.run's own checkpoints live
+#: (one run per checkpoint directory; the serving layer keys by job id
+#: instead)
+_RUN_CKPT_ID = "run"
+
 
 class TPUEngineResult(dict):
     """Final per-vertex arrays + run metadata (+ MapReduce results in
@@ -140,7 +145,18 @@ class TPUGraphComputer:
 
     def run(self, program: DenseProgram, params: Optional[dict] = None,
             snapshot: Optional[GraphSnapshot] = None,
-            map_reduces: Optional[list] = None) -> TPUEngineResult:
+            map_reduces: Optional[list] = None, *,
+            resume_from: Optional[str] = None,
+            checkpoint_to: Optional[str] = None,
+            checkpoint_every: int = 0) -> TPUEngineResult:
+        """Run a DenseProgram; optionally through the checkpoint plane
+        (olap/recovery): ``checkpoint_to`` + ``checkpoint_every`` write
+        a digest-verified checkpoint directory every N iterations, and
+        ``resume_from`` reloads the newest VALID checkpoint under that
+        path (torn/corrupted ones are skipped by digest) and continues
+        the round loop — bit-equal to an uninterrupted run. Checkpoint
+        paths are single-device only (the sharded loop never leaves the
+        device mesh mid-run)."""
         if map_reduces:
             # validate BEFORE the expensive BSP run
             from titan_tpu.olap.api import DenseMapReduce, MapReduce
@@ -151,10 +167,36 @@ class TPUGraphComputer:
         ndev = self.num_devices
         if ndev <= 0:
             ndev = len(jax.devices())
-        if ndev == 1:
-            result = run_single(program, snap, params)
+        if resume_from is None and checkpoint_to is None:
+            if ndev == 1:
+                result = run_single(program, snap, params)
+            else:
+                result = run_sharded(program, snap, params,
+                                     vertex_mesh(ndev))
         else:
-            result = run_sharded(program, snap, params, vertex_mesh(ndev))
+            if ndev != 1:
+                raise ValueError(
+                    "resume_from/checkpoint_to need the single-device "
+                    "engine (set num_devices=1)")
+            from titan_tpu.olap.recovery import CheckpointStore
+            resume = None
+            if resume_from is not None:
+                ck = CheckpointStore(resume_from).latest(_RUN_CKPT_ID)
+                if ck is not None and ck.kind == "dense":
+                    resume = {"state": ck.arrays, "iteration": ck.round}
+            ckpt_cb = None
+            if checkpoint_to is not None and checkpoint_every > 0:
+                wstore = CheckpointStore(checkpoint_to)
+                attempt = ck.attempt + 1 if resume is not None else 1
+
+                def ckpt_cb(it, state, _st=wstore, _at=attempt):
+                    _st.save(_RUN_CKPT_ID, attempt=_at, round_=it,
+                             kind="dense",
+                             arrays={k: np.asarray(v)
+                                     for k, v in state.items()})
+            result = run_single(program, snap, params, resume=resume,
+                                checkpoint=ckpt_cb,
+                                checkpoint_every=checkpoint_every)
         if map_reduces:
             self._run_map_reduces(map_reduces, result, snap, params or {})
         return result
@@ -192,9 +234,15 @@ class TPUGraphComputer:
 # single device
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnums=(0,), static_argnames=("max_iter", "n"))
+@partial(jax.jit, static_argnums=(0,), static_argnames=("n",))
 def _iterate_single(program: DenseProgram, state: dict, src, dst, edata: dict,
-                    seg_meta: tuple, params: dict, max_iter: int, n: int):
+                    seg_meta: tuple, params: dict, it0, it_end, n: int):
+    """BSP iterations [it0, it_end) (both TRACED, so the checkpoint
+    plane's chunked calls share one compile); each superstep is a pure
+    function of (state, absolute iteration), so chunked execution is
+    bit-equal to one monolithic while_loop. Returns (state, iterations
+    run so far, done flag) — ``done`` lets the chunking caller stop at
+    a mid-chunk convergence."""
     last_idx, seg_has = seg_meta
 
     def superstep(carry):
@@ -209,11 +257,12 @@ def _iterate_single(program: DenseProgram, state: dict, src, dst, edata: dict,
 
     def cond(carry):
         _, it, done = carry
-        return jnp.logical_and(it < max_iter, jnp.logical_not(done))
+        return jnp.logical_and(it < it_end, jnp.logical_not(done))
 
-    state, iters, _ = jax.lax.while_loop(cond, superstep,
-                                         (state, jnp.int32(0), jnp.array(False)))
-    return state, iters
+    state, iters, done = jax.lax.while_loop(
+        cond, superstep,
+        (state, jnp.asarray(it0, jnp.int32), jnp.array(False)))
+    return state, iters, done
 
 
 def _device_graph_single(snap: GraphSnapshot):
@@ -231,19 +280,54 @@ def _device_graph_single(snap: GraphSnapshot):
 
 
 def run_single(program: DenseProgram, snap: GraphSnapshot,
-               params: Optional[dict] = None) -> TPUEngineResult:
+               params: Optional[dict] = None, *,
+               resume: Optional[dict] = None, checkpoint=None,
+               checkpoint_every: int = 0) -> TPUEngineResult:
+    """One DenseProgram run on a single device.
+
+    Checkpoint plane (olap/recovery): with ``checkpoint_every > 0`` the
+    while_loop runs in cadence-aligned chunks and
+    ``checkpoint(iteration, state)`` fires at each boundary (state is
+    the device dict; the callback owns readback/persistence).
+    ``resume={"state": {...}, "iteration": i}`` continues from a
+    captured boundary — chunked and resumed runs are bit-equal to a
+    monolithic run because each superstep is a pure function of
+    (state, absolute iteration)."""
     params = dict(params or {})
     n = snap.n
-    state = {k: jnp.asarray(v) for k, v in program.init(n, params).items()}
+    if resume is not None:
+        state = {k: jnp.asarray(v) for k, v in resume["state"].items()}
+        it = int(resume["iteration"])
+    else:
+        state = {k: jnp.asarray(v)
+                 for k, v in program.init(n, params).items()}
+        it = 0
     src, dst, edata, seg_meta = _device_graph_single(snap)
     edata = {k: edata[k] for k in program.edge_keys()} if program.edge_keys() \
         else edata
-    state, iters = _iterate_single(program, state, src, dst, edata, seg_meta,
-                                   _traceable(params),
-                                   max_iter=program.max_iterations, n=n)
+    tparams = _traceable(params)
+    max_iter = program.max_iterations
+    every = int(checkpoint_every or 0)
+    if checkpoint is None or every <= 0:
+        state, iters, _ = _iterate_single(program, state, src, dst, edata,
+                                          seg_meta, tparams, it, max_iter,
+                                          n=n)
+        it = int(iters)
+    else:
+        done = False
+        while it < max_iter and not done:
+            # next cadence boundary (cadence-aligned regardless of the
+            # resume point, so checkpoint rounds are stable identifiers)
+            it_end = min(max_iter, (it // every + 1) * every)
+            state, iters, done_dev = _iterate_single(
+                program, state, src, dst, edata, seg_meta, tparams,
+                it, it_end, n=n)
+            it = int(iters)
+            done = bool(done_dev)
+            checkpoint(it, state)
     outputs = program.outputs(state, params)
     return TPUEngineResult({k: np.asarray(v) for k, v in outputs.items()},
-                           int(iters), n)
+                           it, n)
 
 
 # ---------------------------------------------------------------------------

@@ -105,16 +105,20 @@ class GraphSnapshot:
                 "refresh) — delta refresh is unsound; rebuild the "
                 "snapshot")
         new_epoch = g.mutation_epoch
-        pending: list = []
-        # pop-drain UP TO new_epoch only: a commit that bumped the epoch
+        # drain UP TO new_epoch only: a commit that bumped the epoch
         # we read has already queued its payload (push precedes bump,
         # under the commit lock), but a commit racing THIS refresh may
         # queue payloads with epoch > new_epoch — those must stay queued
         # for the next refresh, or its continuity check would find a
-        # hole and force a spurious rebuild
-        while q and (q[0].get("epoch") is None
-                     or q[0]["epoch"] <= new_epoch):
-            pending.append(q.pop(0))
+        # hole and force a spurious rebuild. Scan-then-slice, not
+        # pop(0)-per-payload: against the 10k-commit backlog cap the
+        # per-pop list shift made this drain O(backlog^2)
+        cut = 0
+        while cut < len(q) and (q[cut].get("epoch") is None
+                                or q[cut]["epoch"] <= new_epoch):
+            cut += 1
+        pending = list(q[:cut])
+        del q[:cut]
         # continuity: the payloads must cover exactly
         # (self.epoch, new_epoch] — a gap means commits this listener
         # never saw (e.g. they landed during build()'s store scan), and

@@ -18,12 +18,20 @@ Endpoints:
   POST   /jobs      — submit an async OLAP job (olap/serving): body
                       {"kind": "bfs", "source": <vertex id>, ...,
                        "priority": 0, "timeout_s": 30, "deadline_s": 60,
-                       "targets": [ids]} → 202 {"job": id}. Same-snapshot
-                      BFS jobs fuse into one batched [K, n] device run.
+                       "targets": [ids], "max_retries": 0,
+                       "checkpoint_every": 0} → 202 {"job": id}.
+                      Same-snapshot BFS jobs fuse into one batched
+                      [K, n] device run; max_retries/checkpoint_every
+                      opt into the recovery plane (olap/recovery —
+                      RETRYING + resume-from-checkpoint; checkpoints
+                      need a scheduler with checkpoint_dir set).
   GET    /jobs      — scheduler stats + job summaries
-  GET    /jobs/<id> — job status/result/metrics envelope
-  DELETE /jobs/<id> — cancel (queued: immediate; running: at the next
-                      level boundary via the per-job early-exit mask)
+  GET    /jobs/<id> — job status/result/metrics envelope (incl. attempt
+                      / checkpoint_round / rounds_replayed / retry_at
+                      for jobs on the recovery plane)
+  DELETE /jobs/<id> — cancel (queued or retrying: immediate; running:
+                      at the next level boundary via the per-job
+                      early-exit mask)
 
 Server config is a YAML file (gremlin-server.yaml analog):
   host: 127.0.0.1
@@ -150,7 +158,11 @@ class GraphServer:
                        deadline=deadline,
                        timeout_s=timeout_s,
                        labels=body.get("labels"),
-                       directed=bool(body.get("directed", False)))
+                       edge_keys=tuple(body.get("edge_keys") or ()),
+                       directed=bool(body.get("directed", False)),
+                       max_retries=int(body.get("max_retries", 0)),
+                       checkpoint_every=int(
+                           body.get("checkpoint_every", 0)))
         return self.scheduler().submit(spec)
 
     # -- script evaluation ---------------------------------------------------
