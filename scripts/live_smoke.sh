@@ -1,0 +1,136 @@
+#!/usr/bin/env bash
+# Live-plane smoke (ISSUE r9): a SEPARATE writer process commits tagged
+# transactions through a shared sqlite store while a server process
+# (live plane + scheduler + HTTP) runs BFS jobs against the overlay.
+# Asserts: (1) bounded freshness lag — after the writer exits, GET /live
+# reports lag_epochs == 0 within a few seconds without any snapshot
+# rebuild on the serving path; (2) BIT-EQUALITY — the final job's full
+# distance array matches a post-hoc rebuilt snapshot; (3) the
+# serving.live.* surface (feed batches, overlay fill, epochs) is
+# observable end-to-end over the wire.
+#
+# Usage: scripts/live_smoke.sh   (CPU-safe; ~40s incl. XLA compiles)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python - <<'EOF'
+import json
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import titan_tpu
+from titan_tpu.models.bfs_hybrid import frontier_bfs_batched
+from titan_tpu.olap.live import LiveGraphPlane
+from titan_tpu.olap.serving.scheduler import JobScheduler
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.server import GraphServer
+
+shared = tempfile.mkdtemp(prefix="live_smoke_") + "/db"
+g = titan_tpu.open({"storage.backend": "sqlite",
+                    "storage.directory": shared,
+                    "graph.unique-instance-id": "server"})
+tx = g.new_transaction()
+vs = [tx.add_vertex("node", name=f"v{i:02d}") for i in range(12)]
+for a, b in [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5), (5, 6), (6, 7)]:
+    vs[a].add_edge("link", vs[b])
+tx.commit()
+tx = g.new_transaction()
+ids = sorted(v.id for v in tx.vertices())
+tx.rollback()
+
+plane = LiveGraphPlane(g, log_identifier="live", poll_interval_s=0.05)
+sched = JobScheduler(live=plane)
+srv = GraphServer(g, port=0, scheduler=sched).start()
+print(f"live_smoke: server on {srv.host}:{srv.port}, store {shared}")
+
+
+def req(path, payload=None, method="GET"):
+    r = urllib.request.Request(
+        f"http://{srv.host}:{srv.port}{path}",
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"}, method=method)
+    with urllib.request.urlopen(r, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+# ---- separate WRITER PROCESS: 15 tagged commits through the store ----
+writer_code = f'''
+import time
+import titan_tpu
+g = titan_tpu.open({{"storage.backend": "sqlite",
+                     "storage.directory": {shared!r},
+                     "graph.unique-instance-id": "writer"}})
+ids = {ids!r}
+for i in range(15):
+    tx = g.new_transaction(log_identifier="live")
+    tx.vertex(ids[i % 12]).add_edge("link", tx.vertex(ids[(i + 5) % 12]))
+    tx.commit()
+    time.sleep(0.05)
+g.close()
+print("writer: 15 tagged commits done", flush=True)
+'''
+writer = subprocess.Popen([sys.executable, "-c", writer_code])
+
+# BFS jobs stream in while the writer is committing
+jobs = []
+while writer.poll() is None:
+    jobs.append(req("/jobs", {"kind": "bfs", "source": ids[0]},
+                    method="POST")["job"])
+    time.sleep(0.3)
+assert writer.returncode == 0, "writer process failed"
+print(f"live_smoke: {len(jobs)} jobs submitted under writes")
+
+# ---- bounded freshness lag: the feed drains within seconds ----------
+deadline = time.time() + 30
+lag = None
+while time.time() < deadline:
+    live = req("/live")
+    lag = live["freshness"]
+    if lag["lag_epochs"] == 0 and lag["feed_pending"] == 0 \
+            and live["counters"]["feed_batches"] >= 15:
+        break
+    time.sleep(0.2)
+else:
+    raise SystemExit(f"freshness lag not bounded: {lag}")
+print("live_smoke: freshness lag drained:", json.dumps(lag),
+      "| overlay:", json.dumps(live["overlay"]))
+assert live["counters"]["feed_batches"] >= 15
+
+# ---- bit-equality vs a post-hoc rebuilt snapshot --------------------
+job = req("/jobs", {"kind": "bfs", "source": ids[0]}, method="POST")
+jid = job["job"]
+deadline = time.time() + 60
+while time.time() < deadline:
+    body = req(f"/jobs/{jid}")
+    if body["status"] not in ("queued", "running"):
+        break
+    time.sleep(0.1)
+assert body["status"] == "done", body
+assert "epoch" in body, body
+dist_live = sched.get(jid).result["dist"]
+
+rebuilt = snap_mod.build(g, directed=False)
+dist_rb, _, _ = frontier_bfs_batched(rebuilt, [rebuilt.dense_of(ids[0])])
+assert dist_live.shape == dist_rb[0].shape
+assert (np.asarray(dist_live) == np.asarray(dist_rb[0])).all(), \
+    "live result != rebuilt snapshot"
+print(f"live_smoke: final BFS bit-equal to rebuilt snapshot "
+      f"(reached {int((dist_live < (1 << 30)).sum())}, "
+      f"epoch {body['epoch']})")
+
+# every in-flight job completed too
+for jid in jobs:
+    body = req(f"/jobs/{jid}")
+    assert body["status"] == "done", body
+
+srv.stop()
+g.close()
+print("live_smoke: OK")
+EOF

@@ -186,14 +186,16 @@ def test_round_loop_modules_are_nonzero_free():
     round-loop hot paths.) The ban extends to the serving layer
     (ISSUE r7): its batched [K, n] round loops — and any future kernel
     code under olap/serving/ — must use the compaction primitives too;
-    and (ISSUE r8) to olap/recovery/, whose checkpoint callbacks run
-    INSIDE the round loops."""
+    (ISSUE r8) to olap/recovery/, whose checkpoint callbacks run
+    INSIDE the round loops; and (ISSUE r9) to olap/live/, whose
+    overlay views feed per-round expansion passes."""
     import importlib
     import inspect
     import io
     import pkgutil
     import tokenize
 
+    import titan_tpu.olap.live as live_pkg
     import titan_tpu.olap.recovery as recovery_pkg
     import titan_tpu.olap.serving as serving_pkg
     from titan_tpu.models import bfs_hybrid, bfs_hybrid_sharded, frontier
@@ -206,9 +208,13 @@ def test_round_loop_modules_are_nonzero_free():
         importlib.import_module(f"titan_tpu.olap.recovery.{m.name}")
         for m in pkgutil.iter_modules(recovery_pkg.__path__)]
     assert len(recovery_mods) >= 3  # store/checkpoint/faults
+    live_mods = [
+        importlib.import_module(f"titan_tpu.olap.live.{m.name}")
+        for m in pkgutil.iter_modules(live_pkg.__path__)]
+    assert len(live_mods) >= 4      # feed/overlay/compactor/plane
 
     for mod in (frontier, bfs_hybrid, bfs_hybrid_sharded,
-                *serving_mods, *recovery_mods):
+                *serving_mods, *recovery_mods, *live_mods):
         src = inspect.getsource(mod)
         calls = [
             (tok.start[0], line)

@@ -1,0 +1,240 @@
+"""Live overlay property tests: base+overlay ≡ freshly rebuilt snapshot.
+
+The acceptance contract of the live plane's device half (ISSUE r9): for
+randomized commit streams of edge adds/removals applied to a
+DeltaOverlay, BFS / batched multi-source BFS / SSSP / WCC over
+(base CSR + overlay view) are BIT-EQUAL to running on a snapshot
+rebuilt from the final edge list — while the base chunked-CSR device
+arrays stay untouched.
+
+All tests share the n=192 / m=900 / seed-42 graph shape and fixed pow-2
+overlay capacities so the jit shape buckets compile once for the whole
+module (tier-1 serial CPU budget).
+
+SSSP runs with UNIFORM weights (w_range=0): hashed weights are keyed on
+edge SLOT ids, which a rebuild re-assigns — layout-dependent weights
+cannot be bit-stable across compaction by construction (docs/live.md).
+"""
+
+import numpy as np
+import pytest
+
+from titan_tpu.models.bfs_hybrid import (frontier_bfs_batched,
+                                         frontier_bfs_hybrid)
+from titan_tpu.models.frontier import (frontier_sssp, frontier_wcc,
+                                       pagerank_dense)
+from titan_tpu.olap.live.overlay import DeltaOverlay
+from titan_tpu.olap.tpu import snapshot as snap_mod
+
+N, M, SEED = 192, 900, 42
+CAP = 256          # fixed pow-2 overlay capacity bucket
+
+
+def _base_edges(rng):
+    src = rng.integers(0, N, M).astype(np.int32)
+    dst = rng.integers(0, N, M).astype(np.int32)
+    return src, dst
+
+
+def _sym_snapshot(src, dst):
+    return snap_mod.from_arrays(N, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+
+
+def _apply_stream(rng, src, dst, n_add, n_rm):
+    """Random delta stream against a fresh base; returns (base snapshot,
+    overlay view, rebuilt snapshot over the final edge list)."""
+    base = _sym_snapshot(src, dst)
+    ov = DeltaOverlay(base, min_cap=CAP)
+    a_s = rng.integers(0, N, n_add).astype(np.int32)
+    a_d = rng.integers(0, N, n_add).astype(np.int32)
+    ov.append_edges(np.concatenate([a_s, a_d]),
+                    np.concatenate([a_d, a_s]),
+                    np.zeros(2 * n_add, np.int32))
+    rm_idx = rng.choice(M, n_rm, replace=False)
+    for i in rm_idx:
+        assert ov.remove_edge(int(src[i]), int(dst[i]), None)
+        assert ov.remove_edge(int(dst[i]), int(src[i]), None)
+    keep = np.ones(M, bool)
+    keep[rm_idx] = False
+    fs = np.concatenate([src[keep], a_s])
+    fd = np.concatenate([dst[keep], a_d])
+    return base, ov.view(), _sym_snapshot(fs, fd)
+
+
+@pytest.mark.parametrize(
+    "round_", [0,
+               pytest.param(1, marks=pytest.mark.slow),
+               pytest.param(2, marks=pytest.mark.slow)])
+def test_bfs_batched_bit_equal_to_rebuild(round_):
+    rng = np.random.default_rng(SEED + round_)
+    base, view, rebuilt = _apply_stream(rng, *_base_edges(rng),
+                                        n_add=60, n_rm=40)
+    sources = [int(x) for x in rng.choice(N, 4, replace=False)]
+    d_ov, lv_ov, c_ov = frontier_bfs_batched(base, sources,
+                                             overlay=view)
+    d_rb, lv_rb, c_rb = frontier_bfs_batched(rebuilt, sources)
+    assert (d_ov == d_rb).all()
+    assert (lv_ov == lv_rb).all() and (c_ov == c_rb).all()
+
+
+def test_sssp_uniform_weights_bit_equal_to_rebuild():
+    rng = np.random.default_rng(SEED)
+    base, view, rebuilt = _apply_stream(rng, *_base_edges(rng),
+                                        n_add=60, n_rm=40)
+    s = int(np.flatnonzero(rebuilt.out_degree > 0)[0])
+    d_ov, _ = frontier_sssp(base, s, min_w=1.0, w_range=0.0,
+                            overlay=view)
+    d_rb, _ = frontier_sssp(rebuilt, s, min_w=1.0, w_range=0.0)
+    assert (np.asarray(d_ov) == np.asarray(d_rb)).all()
+
+
+def test_wcc_bit_equal_to_rebuild():
+    rng = np.random.default_rng(SEED + 7)
+    base, view, rebuilt = _apply_stream(rng, *_base_edges(rng),
+                                        n_add=60, n_rm=40)
+    lab_ov, _ = frontier_wcc(base, overlay=view)
+    lab_rb, _ = frontier_wcc(rebuilt)
+    assert (np.asarray(lab_ov) == np.asarray(lab_rb)).all()
+
+
+def test_overlay_only_reachable_vertex():
+    """A vertex with NO base edges, connected purely through overlay
+    adds, must be found — including through overlay-only CHAINS (the
+    empty-plan relax path in _frontier_run)."""
+    rng = np.random.default_rng(SEED)
+    # base graph leaves vertices N-3..N-1 isolated
+    src = rng.integers(0, N - 3, M).astype(np.int32)
+    dst = rng.integers(0, N - 3, M).astype(np.int32)
+    base = _sym_snapshot(src, dst)
+    ov = DeltaOverlay(base, min_cap=CAP)
+    # chain: 0 -> N-3 -> N-2 -> N-1 (symmetrized)
+    a_s = np.asarray([0, N - 3, N - 2], np.int32)
+    a_d = np.asarray([N - 3, N - 2, N - 1], np.int32)
+    ov.append_edges(np.concatenate([a_s, a_d]),
+                    np.concatenate([a_d, a_s]), np.zeros(6, np.int32))
+    view = ov.view()
+    rebuilt = _sym_snapshot(np.concatenate([src, a_s]),
+                            np.concatenate([dst, a_d]))
+    d_ov, _, _ = frontier_bfs_batched(base, [0], overlay=view)
+    d_rb, _, _ = frontier_bfs_batched(rebuilt, [0])
+    assert (d_ov == d_rb).all()
+    assert d_ov[0, N - 1] < (1 << 30)        # reached through the chain
+    s_ov, _ = frontier_sssp(base, 0, min_w=1.0, w_range=0.0,
+                            overlay=view)
+    s_rb, _ = frontier_sssp(rebuilt, 0, min_w=1.0, w_range=0.0)
+    assert (np.asarray(s_ov) == np.asarray(s_rb)).all()
+    w_ov, _ = frontier_wcc(base, overlay=view)
+    w_rb, _ = frontier_wcc(rebuilt)
+    assert (np.asarray(w_ov) == np.asarray(w_rb)).all()
+
+
+def test_tombstones_disconnect_bridge():
+    """Removing every bridge row must make the far side unreachable —
+    tombstoned slots may not count as parents."""
+    # path 0-1-2-3, bridge 1-2
+    src = np.asarray([0, 1, 2] + [4] * (M - 3), np.int32)
+    dst = np.asarray([1, 2, 3] + [5] * (M - 3), np.int32)
+    base = _sym_snapshot(src, dst)
+    ov = DeltaOverlay(base, min_cap=CAP)
+    assert ov.remove_edge(1, 2, None) and ov.remove_edge(2, 1, None)
+    view = ov.view()
+    d_ov, _, _ = frontier_bfs_batched(base, [0], overlay=view)
+    assert d_ov[0, 1] == 1 and d_ov[0, 2] >= (1 << 30) \
+        and d_ov[0, 3] >= (1 << 30)
+    lab, _ = frontier_wcc(base, overlay=view)
+    lab = np.asarray(lab)
+    assert lab[0] == lab[1] and lab[2] == lab[3] and lab[0] != lab[2]
+
+
+def test_remove_edge_kills_pending_overlay_add():
+    rng = np.random.default_rng(SEED)
+    src, dst = _base_edges(rng)
+    base = _sym_snapshot(src, dst)
+    ov = DeltaOverlay(base, min_cap=CAP)
+    ov.append_edges(np.asarray([3, 7], np.int32),
+                    np.asarray([7, 3], np.int32),
+                    np.zeros(2, np.int32))
+    assert ov.remove_edge(3, 7, None) and ov.remove_edge(7, 3, None)
+    assert ov.dead_adds == 2 and ov.tomb_count == 0
+    view = ov.view()
+    d_ov, _, _ = frontier_bfs_batched(base, [3], overlay=view)
+    d_rb, _, _ = frontier_bfs_batched(base, [3])
+    assert (d_ov == d_rb).all()          # net no-op delta
+
+
+def test_capacity_buckets_are_pow2_and_stable():
+    rng = np.random.default_rng(SEED)
+    src, dst = _base_edges(rng)
+    base = _sym_snapshot(src, dst)
+    ov = DeltaOverlay(base, min_cap=CAP)
+    caps = set()
+    for k in range(5):
+        a = rng.integers(0, N, 100).astype(np.int32)
+        b = rng.integers(0, N, 100).astype(np.int32)
+        ov.append_edges(a, b, np.zeros(100, np.int32))
+        caps.add(ov.cap)
+        v = ov.view()
+        assert v.cap == ov.cap and v.src_dev.shape == (ov.cap,)
+    # power-of-two buckets only — appends within a bucket never change
+    # the compiled kernel shapes
+    assert all(c & (c - 1) == 0 for c in caps)
+    assert ov.cap == 512 and ov.count == 500
+
+
+def test_view_is_immutable_under_later_appends():
+    """A leased view must keep serving its epoch while the overlay
+    moves on (the consistent-pair lease contract)."""
+    rng = np.random.default_rng(SEED)
+    src, dst = _base_edges(rng)
+    base = _sym_snapshot(src, dst)
+    ov = DeltaOverlay(base, min_cap=CAP)
+    ov.append_edges(np.asarray([0], np.int32),
+                    np.asarray([1], np.int32), np.zeros(1, np.int32))
+    v1 = ov.view()
+    ov.append_edges(np.asarray([2], np.int32),
+                    np.asarray([3], np.int32), np.zeros(1, np.int32))
+    ov.remove_edge(int(src[0]), int(dst[0]), None)
+    v2 = ov.view()
+    assert v1.count == 1 and v2.count == 2
+    assert v1.tomb_count == 0 and v2.tomb_count == 1
+    assert int(np.asarray(v1.src_dev[1])) == N + 1   # still padded
+    assert v1.seq < v2.seq
+
+
+def test_base_device_csr_untouched_by_overlay():
+    """The whole point: applying deltas through the overlay must not
+    invalidate the base snapshot's chunked-CSR device cache."""
+    rng = np.random.default_rng(SEED)
+    src, dst = _base_edges(rng)
+    base = _sym_snapshot(src, dst)
+    frontier_bfs_batched(base, [0])                  # builds + caches
+    cached = base._hybrid_csr
+    ov = DeltaOverlay(base, min_cap=CAP)
+    ov.append_edges(np.asarray([0, 1], np.int32),
+                    np.asarray([1, 0], np.int32), np.zeros(2, np.int32))
+    ov.remove_edge(int(src[0]), int(dst[0]), None)
+    frontier_bfs_batched(base, [0], overlay=ov.view())
+    assert base._hybrid_csr is cached
+
+
+def test_guards_on_dirty_overlay():
+    """Kernels without an overlay seam refuse loudly instead of
+    silently answering from the stale base."""
+    rng = np.random.default_rng(SEED)
+    src, dst = _base_edges(rng)
+    base = _sym_snapshot(src, dst)
+    ov = DeltaOverlay(base, min_cap=CAP)
+    ov.append_edges(np.asarray([0], np.int32),
+                    np.asarray([1], np.int32), np.zeros(1, np.int32))
+    base._live_overlay = ov.view()
+    with pytest.raises(RuntimeError, match="overlay"):
+        frontier_bfs_hybrid(base, 0)
+    with pytest.raises(RuntimeError, match="compact"):
+        pagerank_dense(base, iterations=1)
+    # an explicitly-passed EMPTY view (the compacted lease) overrides
+    # the snapshot's attached dirty view
+    base2 = _sym_snapshot(src, dst)
+    empty = DeltaOverlay(base2, min_cap=CAP).view()
+    rank, _ = pagerank_dense(base, iterations=1, overlay=empty)
+    assert np.isfinite(np.asarray(rank)).all()

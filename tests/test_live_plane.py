@@ -1,0 +1,289 @@
+"""LiveGraphPlane + serving integration (olap/live, ISSUE r9).
+
+End-to-end freshness under writes: commits land in the device overlay
+(base CSR cache untouched), vertex-set changes compact + republish,
+the pool leases (snapshot, overlay-view) pairs at consistent epochs,
+jobs report the epoch they ran at, and ``GET /live`` exposes the
+``serving.live.*`` surface.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import titan_tpu
+from titan_tpu.models.bfs_hybrid import frontier_bfs_batched
+from titan_tpu.olap.api import JobSpec
+from titan_tpu.olap.live import EpochCompactor, LiveGraphPlane
+from titan_tpu.olap.serving.scheduler import JobScheduler
+from titan_tpu.olap.tpu import snapshot as snap_mod
+
+
+@pytest.fixture
+def graph():
+    g = titan_tpu.open("inmemory")
+    tx = g.new_transaction()
+    vs = [tx.add_vertex("node", name=f"v{i:02d}") for i in range(10)]
+    for a, b in [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5), (5, 6)]:
+        vs[a].add_edge("link", vs[b])
+    tx.commit()
+    yield g
+    g.close()
+
+
+def _ids(g):
+    tx = g.new_transaction()
+    ids = sorted(v.id for v in tx.vertices())
+    tx.rollback()
+    return ids
+
+
+def _vertex(tx, g, i):
+    return tx.vertex(_ids(g)[i])
+
+
+#: lax policy so tiny test graphs don't auto-compact on every delta
+LAX = EpochCompactor(max_fill=0.99, max_tomb_fraction=0.99)
+
+
+def test_edge_deltas_flow_through_overlay_not_rebuild(graph):
+    plane = LiveGraphPlane(graph, compactor=LAX)
+    try:
+        snap0, v0, i0 = plane.lease_state()
+        frontier_bfs_batched(snap0, [0], overlay=v0)
+        cached = snap0._hybrid_csr
+        tx = graph.new_transaction()
+        _vertex(tx, graph, 6).add_edge("link", _vertex(tx, graph, 7))
+        tx.commit()
+        snap1, v1, i1 = plane.lease_state()
+        assert snap1 is snap0              # no republish
+        assert snap0._hybrid_csr is cached  # no device re-upload
+        assert v1.count == 2 and i1["epoch"] == 0
+        assert i1["applied_epoch"] == graph.mutation_epoch
+        # results see the commit, bit-equal to a rebuild
+        d_ov, _, _ = frontier_bfs_batched(snap1, [0], overlay=v1)
+        rebuilt = snap_mod.build(graph, directed=False)
+        d_rb, _, _ = frontier_bfs_batched(rebuilt, [0])
+        assert (d_ov == d_rb).all()
+    finally:
+        plane.close()
+
+
+def test_edge_removal_tombstones_then_compaction_folds(graph):
+    plane = LiveGraphPlane(graph, compactor=LAX)
+    try:
+        snap0, _, _ = plane.lease_state()
+        tx = graph.new_transaction()
+        e = next(iter(_vertex(tx, graph, 0).out_edges("link")))
+        e.remove()
+        tx.commit()
+        snap1, v1, i1 = plane.lease_state()
+        assert snap1 is snap0 and v1.tomb_count == 2  # both rows
+        d_ov, _, _ = frontier_bfs_batched(snap1, [0], overlay=v1)
+        rebuilt = snap_mod.build(graph, directed=False)
+        d_rb, _, _ = frontier_bfs_batched(rebuilt, [0])
+        assert (d_ov == d_rb).all()
+        assert plane.compact_if_dirty()
+        snap2, v2, i2 = plane.lease_state()
+        assert snap2 is not snap1 and v2.empty
+        assert i2["epoch"] == i1["epoch"] + 1
+        assert snap2.num_edges == rebuilt.num_edges
+        d2, _, _ = frontier_bfs_batched(snap2, [0])
+        assert (d2 == d_rb).all()
+    finally:
+        plane.close()
+
+
+def test_vertex_change_triggers_compaction_republish(graph):
+    plane = LiveGraphPlane(graph, compactor=LAX)
+    try:
+        snap0, _, i0 = plane.lease_state()
+        tx = graph.new_transaction()
+        w = tx.add_vertex("node", name="v99")
+        _vertex(tx, graph, 2).add_edge("link", w)
+        tx.commit()
+        snap1, v1, i1 = plane.lease_state()
+        assert snap1 is not snap0            # republished
+        assert i1["epoch"] == i0["epoch"] + 1 and v1.empty
+        fresh = snap_mod.build(graph, directed=False)
+        assert snap1.n == fresh.n
+        assert (snap1.vertex_ids == fresh.vertex_ids).all()
+        assert (snap1.src == fresh.src).all()
+        assert (snap1.dst == fresh.dst).all()
+    finally:
+        plane.close()
+
+
+def test_pool_retires_leased_base_on_republish(graph):
+    from titan_tpu.olap.serving.pool import SnapshotPool
+
+    plane = LiveGraphPlane(graph, compactor=LAX)
+    pool = SnapshotPool(live=plane)
+    try:
+        lease = pool.acquire()
+        old = lease.snapshot
+        edges_before = old.num_edges
+        assert lease.overlay is not None and lease.epoch_info is not None
+        # vertex add → compaction → republish while the lease is out
+        tx = graph.new_transaction()
+        tx.add_vertex("node", name="v98")
+        tx.commit()
+        with pool.acquire() as snap2:
+            assert snap2 is not old
+        assert pool.stats()["retired"] == 1
+        assert old.num_edges == edges_before   # leased arrays untouched
+        lease.release()
+        assert pool.stats()["retired"] == 0
+    finally:
+        pool.close()
+        plane.close()
+
+
+def test_overlay_budget_compaction_and_metrics(graph):
+    from titan_tpu.utils.metrics import MetricManager
+
+    metrics = MetricManager()
+    plane = LiveGraphPlane(graph, metrics=metrics,
+                           compactor=EpochCompactor(
+                               max_fill=0.99, max_tomb_fraction=0.1))
+    try:
+        # removals push the tombstone fraction over 0.2 → auto-compact
+        tx = graph.new_transaction()
+        for e in list(_vertex(tx, graph, 1).out_edges("link")):
+            e.remove()
+        tx.commit()
+        _, view, info = plane.lease_state()
+        assert info["epoch"] >= 1 and view.empty
+        st = plane.stats()
+        assert st["counters"]["compactions"] >= 1
+        assert st["counters"]["edges_tombstoned"] >= 1
+        assert st["freshness"]["lag_epochs"] == 0
+        assert st["apply_ms"]["count"] >= 1
+    finally:
+        plane.close()
+
+
+def test_scheduler_jobs_under_writes_report_epoch(graph):
+    plane = LiveGraphPlane(graph, compactor=LAX)
+    sched = JobScheduler(live=plane)
+    try:
+        ids = _ids(graph)
+        j1 = sched.submit(JobSpec(kind="bfs",
+                                  params={"source": ids[0]}))
+        assert j1.wait(60) and j1.result is not None
+        r1 = j1.result["reached"]
+        tx = graph.new_transaction()
+        _vertex(tx, graph, 6).add_edge("link", _vertex(tx, graph, 7))
+        tx.commit()
+        j2 = sched.submit(JobSpec(kind="bfs",
+                                  params={"source": ids[0]}))
+        assert j2.wait(60) and j2.result is not None
+        assert j2.result["reached"] == r1 + 1       # fresh, no rebuild
+        assert j2.ran_epoch["seq"] > j1.ran_epoch["seq"] \
+            or j2.ran_epoch["epoch"] > j1.ran_epoch["epoch"]
+        assert "epoch" in j2.to_wire()
+        # pagerank compacts before running (dense fallback) and still
+        # completes under the dirty overlay
+        j3 = sched.submit(JobSpec(kind="pagerank",
+                                  params={"iterations": 2}))
+        assert j3.wait(60), j3.error
+        assert j3.state.value == "done", j3.error
+        assert j3.ran_epoch["seq"] == 0             # compacted lease
+        # wcc over the (possibly clean) overlay
+        j4 = sched.submit(JobSpec(kind="wcc"))
+        assert j4.wait(60) and j4.state.value == "done", j4.error
+    finally:
+        sched.close()          # closes the plane too
+
+
+def test_get_live_endpoint(graph):
+    from titan_tpu.server import GraphServer
+
+    plane = LiveGraphPlane(graph, compactor=LAX)
+    sched = JobScheduler(live=plane)
+    srv = GraphServer(graph, port=0, scheduler=sched).start()
+    try:
+        def req(path):
+            with urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}{path}",
+                    timeout=30) as resp:
+                return json.loads(resp.read())
+
+        tx = graph.new_transaction()
+        _vertex(tx, graph, 3).add_edge("link", _vertex(tx, graph, 8))
+        tx.commit()
+        plane.pump()
+        live = req("/live")
+        assert live["enabled"] is True
+        assert live["overlay"]["adds"] == 2
+        for key in ("freshness", "counters", "apply_ms", "compact_ms"):
+            assert key in live
+        assert live["freshness"]["lag_epochs"] == 0
+    finally:
+        srv.stop()
+
+
+def test_plane_background_pump_and_concurrent_writers(graph):
+    """Writers hammer commits while the pump ingests in the background;
+    the final lease must converge to the rebuilt truth."""
+    plane = LiveGraphPlane(graph, compactor=LAX,
+                           poll_interval_s=0.01)
+    errors: list = []
+
+    def writer(k):
+        try:
+            rng = np.random.default_rng(k)
+            ids = _ids(graph)
+            for _ in range(8):
+                tx = graph.new_transaction()
+                a, b = rng.choice(len(ids), 2, replace=False)
+                tx.vertex(ids[int(a)]).add_edge(
+                    "link", tx.vertex(ids[int(b)]))
+                tx.commit()
+        except Exception as e:     # pragma: no cover - fail loud
+            errors.append(repr(e))
+
+    try:
+        ts = [threading.Thread(target=writer, args=(k,))
+              for k in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errors, errors
+        snap, view, info = plane.lease_state()
+        assert info["applied_epoch"] == graph.mutation_epoch
+        d_ov, _, _ = frontier_bfs_batched(snap, [0], overlay=view)
+        rebuilt = snap_mod.build(graph, directed=False)
+        d_rb, _, _ = frontier_bfs_batched(rebuilt, [0])
+        assert (d_ov == d_rb).all()
+    finally:
+        plane.close()
+
+
+def test_resync_on_listener_overflow_reanchors(graph):
+    """Listener overflow → full re-scan; the SAME queue resumes
+    accumulating afterwards (ChangeQueue.reanchor — the ISSUE r9
+    satellite), so the next delta takes the overlay path again."""
+    plane = LiveGraphPlane(graph, compactor=LAX)
+    try:
+        plane._queue.overflowed = True      # simulate >cap backlog
+        tx = graph.new_transaction()
+        _vertex(tx, graph, 0).add_edge("link", _vertex(tx, graph, 9))
+        tx.commit()                          # dropped by the dead queue
+        snap1, v1, i1 = plane.lease_state()
+        assert i1["epoch"] >= 1 and v1.empty          # resynced
+        assert plane.stats()["counters"]["resyncs"] == 1
+        assert not plane._queue.overflowed            # re-anchored
+        # the next commit flows through the overlay again
+        tx = graph.new_transaction()
+        _vertex(tx, graph, 1).add_edge("link", _vertex(tx, graph, 8))
+        tx.commit()
+        snap2, v2, i2 = plane.lease_state()
+        assert snap2 is snap1 and v2.count == 2
+        assert plane.stats()["counters"]["resyncs"] == 1  # no new scan
+    finally:
+        plane.close()

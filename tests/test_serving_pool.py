@@ -126,6 +126,71 @@ def test_pool_never_hands_out_stale_epoch_under_concurrent_commits(graph):
     pool.close()
 
 
+def test_pool_degrades_overflow_to_rebuild_and_reanchors(graph):
+    """ISSUE r9 satellites: a listener overflow must degrade acquire()
+    to a full rebuild — never a job failure — and (with no leases out)
+    the rebuild happens IN PLACE, re-anchoring the change queue so
+    delta refresh works again afterwards (the overflow flag used to
+    stick forever, forcing every future refresh into a rebuild)."""
+    pool = SnapshotPool(graph)
+    try:
+        with pool.acquire() as s1:
+            q = s1._listener
+        q.overflowed = True                   # simulate >cap backlog
+        _add_edge(graph)
+        with pool.acquire() as s2:
+            # same object, rebuilt in place at the fresh epoch
+            assert s2 is s1
+            assert not s2.stale
+            assert s2.epoch == graph.mutation_epoch
+        assert not q.overflowed               # re-anchored
+        # next staleness takes the DELTA path again: the queue
+        # accumulates and refresh() applies without a rebuild
+        edges_before = s1.num_edges
+        _add_edge(graph)
+        assert len(q) == 1
+        with pool.acquire() as s3:
+            assert s3 is s1
+            assert s3.num_edges == edges_before + 2   # delta-applied
+    finally:
+        pool.close()
+
+
+def test_pool_degrades_edge_values_refusal_to_rebuild(graph):
+    """refresh()'s extracted-edge_values NotImplementedError must fall
+    back to a rebuild inside acquire(), not surface to the job."""
+    import numpy as np
+    pool = SnapshotPool(graph)
+    try:
+        with pool.acquire() as s1:
+            s1.edge_values = {"w": np.zeros(s1.num_edges)}
+        _add_edge(graph)
+        with pool.acquire() as s2:
+            assert not s2.stale
+            assert s2.epoch == graph.mutation_epoch
+            assert not s2.edge_values     # rebuilt without edge_keys
+    finally:
+        pool.close()
+
+
+def test_pool_overflow_with_live_lease_replaces(graph):
+    """Overflow while a lease is out: in-place rebuild would mutate a
+    running batch's arrays — the pool must replace instead."""
+    pool = SnapshotPool(graph)
+    try:
+        lease = pool.acquire()
+        old = lease.snapshot
+        old._listener.overflowed = True
+        _add_edge(graph)
+        with pool.acquire() as fresh:
+            assert fresh is not old
+            assert not fresh.stale
+        assert pool.stats()["retired"] == 1
+        lease.release()
+    finally:
+        pool.close()
+
+
 def test_pool_fixed_snapshot_mode():
     n = 6
     src = np.array([0, 1, 2], np.int32)

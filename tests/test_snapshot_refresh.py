@@ -242,6 +242,69 @@ def test_refresh_drains_large_backlog(graph):
     assert list(q) == [future]        # boundary: future payload kept
 
 
+def test_change_queue_reanchor_resumes_accumulation(graph):
+    """ISSUE r9 satellite: once overflowed, push() dropped everything
+    forever; reanchor() (called by rebuild_in_place under the commit
+    lock) clears the backlog AND the flag so delta refresh resumes."""
+    from titan_tpu.core.changes import ChangeQueue
+    q = ChangeQueue(cap=2)
+    q.push({"epoch": 1})
+    q.push({"epoch": 2})
+    q.push({"epoch": 3})                  # trips the cap
+    assert q.overflowed and len(q) == 0
+    q.push({"epoch": 4})                  # dropped while overflowed
+    assert len(q) == 0
+    q.reanchor()
+    assert not q.overflowed
+    q.push({"epoch": 5})
+    assert list(q) == [{"epoch": 5}]
+
+
+def test_rebuild_in_place_after_overflow_restores_delta_refresh(graph):
+    snap = snap_mod.build(graph)
+    q = snap._listener
+    q.overflowed = True
+    tx = graph.new_transaction()
+    vs = list(tx.vertices())
+    vs[0].add_edge("link", vs[1])
+    tx.commit()
+    with pytest.raises(RuntimeError, match="overflow"):
+        snap.refresh()
+    snap.rebuild_in_place()
+    assert snap.epoch == graph.mutation_epoch and not snap.stale
+    assert snap._listener is q and not q.overflowed
+    fresh = snap_mod.build(graph)
+    assert _edge_id_pairs(snap) == _edge_id_pairs(fresh)
+    # the SAME queue feeds the next delta refresh
+    before = snap.num_edges
+    tx = graph.new_transaction()
+    vs = list(tx.vertices())
+    vs[1].add_edge("link", vs[2])
+    tx.commit()
+    stats = snap.refresh()
+    assert stats["added_edges"] == 1
+    assert snap.num_edges == before + 1
+
+
+def test_undirected_removal_drops_both_rows(graph):
+    """Review fix riding ISSUE r9: on symmetrized snapshots a removed
+    relation must drop its forward AND reverse row — the old
+    reverse-key fallback only caught whichever scanned first, silently
+    de-symmetrizing the CSR."""
+    snap = snap_mod.build(graph, directed=False)
+    tx = graph.new_transaction()
+    vs = sorted(tx.vertices(), key=lambda v: v.value("name"))
+    e = next(iter(vs[1].out_edges("link")))
+    e.remove()
+    tx.commit()
+    snap.refresh()
+    fresh = snap_mod.build(graph, directed=False)
+    assert _edge_id_pairs(snap) == _edge_id_pairs(fresh)
+    # symmetry invariant: every row has its mirror
+    pairs = _edge_id_pairs(snap)
+    assert sorted((b, a) for a, b in pairs) == pairs
+
+
 def test_build_retries_when_commit_races_scan(graph, monkeypatch):
     """build() must detect an epoch bump during its store scan and rescan
     (the racing commit may or may not be in the scanned rows)."""

@@ -53,13 +53,31 @@ class ChangeQueue(list):
             return
         self.append(payload)
 
+    def reanchor(self) -> None:
+        """Resume accumulation after the subscriber re-anchored at a
+        freshly rebuilt epoch (``GraphSnapshot.rebuild_in_place`` /
+        the live plane's resync): the dropped backlog is covered by the
+        rebuild's store scan, so the overflow verdict no longer applies.
+        Must be called under the graph's commit lock, atomically with
+        the rebuild's epoch verification — otherwise a commit racing
+        the clear could land in storage but not in the queue (ISSUE r9
+        satellite: the flag was never reset, so one >cap backlog forced
+        every future refresh() into a full rebuild forever)."""
+        self.clear()
+        self.overflowed = False
+
 
 class ChangeState:
     """One committed transaction's change set, as delivered to processors
-    (reference: core/log/ChangeState.java)."""
+    (reference: core/log/ChangeState.java). ``sender`` is the writing
+    instance's rid bytes when the state arrived over the durable log
+    (None for states built directly from payloads) — the live plane's
+    ChangeFeed uses it to drop this instance's own messages, which it
+    already saw through the in-process listener."""
 
-    def __init__(self, payload: dict):
+    def __init__(self, payload: dict, sender: Optional[bytes] = None):
         self._p = payload
+        self.sender = sender
 
     @property
     def txid(self) -> int:
@@ -182,7 +200,8 @@ class LogProcessorFramework:
             # (reference: StandardLogProcessorFramework catches per-processor
             # Throwables)
             try:
-                state = ChangeState(ser.value_from_bytes(msg.content))
+                state = ChangeState(ser.value_from_bytes(msg.content),
+                                    sender=msg.sender)
             except Exception:
                 log_.warning("undecodable change message on %s; skipped",
                              identifier, exc_info=True)

@@ -768,15 +768,21 @@ def _batched_bu():
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
-                           static_argnames=("c_cap", "n_", "fuse"),
+                           static_argnames=("c_cap", "n_", "fuse",
+                                            "masked"),
                            donate_argnums=(0,))
         def bstep(dist, fbits, cand, off, prog, level, dstT, colstart,
-                  degc, c_cap: int, n_: int, fuse: int):
+                  degc, tbits, c_cap: int, n_: int, fuse: int,
+                  masked: bool = False):
             """``fuse`` chunk-check rounds over the shared candidate
             list: chunk ``off`` of each candidate is gathered ONCE and
             tested against all K bitmaps; per-job finds scatter into
             dist rows; a candidate survives while it has chunks left
-            AND some job still has it undecided."""
+            AND some job still has it undecided. With ``masked``,
+            ``tbits`` is the live overlay's tombstone bitmap over edge
+            SLOTS (col*8 + lane): a tombstoned slot never counts as a
+            parent — the expansion seam that keeps the base device CSR
+            valid under edge removals (olap/live)."""
             c_count = prog[0]
             q_pad = dstT.shape[1] - 1
 
@@ -788,8 +794,12 @@ def _batched_bu():
                                  colstart[v] + off, q_pad)
                 parents = jnp.take(dstT, jnp.clip(cols, 0, q_pad),
                                    axis=1)                 # [8, c_cap]
-                hit = _bit_of_batched(fbits, parents) \
-                    .any(axis=1)                           # [K, c_cap]
+                hitl = _bit_of_batched(fbits, parents)     # [K, 8, c_cap]
+                if masked:
+                    lane = jnp.arange(8, dtype=jnp.int32)[:, None]
+                    slot = jnp.clip(cols, 0, q_pad)[None, :] * 8 + lane
+                    hitl = hitl & ~_bit_of(tbits, slot)[None]
+                hit = hitl.any(axis=1)                     # [K, c_cap]
                 undec = dist[:, v] >= INF
                 found = undec & hit & alive[None, :]
                 dist = dist.at[:, jnp.where(alive, v, n_ + 1)].min(
@@ -818,13 +828,16 @@ def _batched_exhaust():
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
-                           static_argnames=("c_cap", "p_cap", "n_"),
+                           static_argnames=("c_cap", "p_cap", "n_",
+                                            "masked"),
                            donate_argnums=(0,))
         def bex(dist, fbits, cand, off, prog, level, dstT, colstart,
-                degc, c_cap: int, p_cap: int, n_: int):
+                degc, tbits, c_cap: int, p_cap: int, n_: int,
+                masked: bool = False):
             """One masked sweep over ALL remaining chunks of the
             surviving candidates (hub stragglers), per-job any-hit via
-            a shared owner scatter."""
+            a shared owner scatter. ``masked``/``tbits``: tombstoned
+            slots never hit (see _batched_bu)."""
             c_count = prog[0]
             valid = jnp.arange(c_cap) < c_count
             v = jnp.minimum(cand, n_)
@@ -833,8 +846,12 @@ def _batched_exhaust():
                 valid, rem, colstart[v] + off, p_cap,
                 dstT.shape[1] - 1, with_owner=True)
             parents = jnp.take(dstT, cols, axis=1)       # [8, p_cap]
-            hit = _bit_of_batched(fbits, parents) \
-                .any(axis=1)                             # [K, p_cap]
+            hitl = _bit_of_batched(fbits, parents)       # [K, 8, p_cap]
+            if masked:
+                lane = jnp.arange(8, dtype=jnp.int32)[:, None]
+                slot = cols[None, :] * 8 + lane
+                hitl = hitl & ~_bit_of(tbits, slot)[None]
+            hit = hitl.any(axis=1)                       # [K, p_cap]
             j = jnp.arange(p_cap, dtype=jnp.int32)
             own = jnp.where(j < p_total, owner, c_cap - 1)
             found_per = jnp.zeros((dist.shape[0], c_cap), jnp.int32) \
@@ -848,10 +865,34 @@ def _batched_exhaust():
     return _get("batched_ex", build)
 
 
+def _overlay_scatter_batched():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("cap", "n_"),
+                           donate_argnums=(0,))
+        def oscat(dist, fbits, ov_src, ov_dst, level, cap: int,
+                  n_: int):
+            """Delta-COO expansion pass: for every live overlay edge
+            (u, v), jobs whose frontier bitmap holds u scatter
+            level+1 into v — the add-edge half of the overlay seam
+            (tombstones mask the base pull; this pushes the adds).
+            Pad entries (n+1) miss every bitmap and drop from the
+            scatter; min keeps earlier levels, so the pass composes
+            with the base sweep in any order."""
+            hit = _bit_of_batched(fbits, ov_src)          # [K, cap]
+            msg = jnp.where(hit, level + 1, INF)
+            return dist.at[:, ov_dst].min(msg, mode="drop")
+        return oscat
+    return _get("batched_overlay_scatter", build)
+
+
 def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
                          on_level=None, return_device: bool = False,
                          init_dist=None, start_level: int = 0,
-                         checkpoint=None):
+                         checkpoint=None, overlay=None):
     """Batched multi-source BFS: run K BFS jobs over the SAME graph as
     one device run with [K, n] state. Each job's ``dist`` row is
     bit-equal to ``frontier_bfs_hybrid`` from that source (BFS distances
@@ -872,6 +913,14 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
     from a captured boundary with bit-equal continuation (``sources``
     then only sizes/validates the batch).
 
+    Live overlay (olap/live): ``overlay`` — an ``OverlayView`` (default:
+    the snapshot's attached ``_live_overlay``) — makes the run
+    overlay-aware: tombstoned base slots stop counting as parents in
+    the bottom-up hit tests, and a per-level delta-COO scatter pass
+    expands the overlay's added edges; the result is bit-equal to a
+    freshly rebuilt snapshot (BFS levels are canonical) while the base
+    device CSR stays resident and untouched.
+
     Returns ``(dist, levels, completed)``: dist [K, n] (device array
     when ``return_device``, else numpy; INF = unreachable — partial for
     non-completed jobs), levels np int32 [K] (the level at which each
@@ -881,8 +930,17 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
+    ov = overlay
+    if ov is None and not isinstance(snap_or_graph, dict):
+        ov = getattr(snap_or_graph, "_live_overlay", None)
+    if ov is not None and ov.empty:
+        ov = None
+    masked = ov is not None and ov.tomb_count > 0
     n = g["n"]
     dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
+    tbits = ov.tomb_dev if masked else jnp.zeros((1,), jnp.uint8)
+    oscat = _overlay_scatter_batched() if ov is not None \
+        and ov.count > 0 else None
     K = len(sources)
     if K == 0:
         raise ValueError("frontier_bfs_batched needs >= 1 source")
@@ -958,6 +1016,14 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
             fbits, cand, stats = bplan(dist, active, dev_scalar(level),
                                        degc, c_cap=cap_n, n_=n)
             st = np.asarray(stats)
+        if oscat is not None:
+            # overlay add-edges expand top-down off the level's final
+            # bitmaps — independent of the base candidate sweep below
+            # (both min-scatter level+1, so order is immaterial), and
+            # it must run even when the base candidate list is empty
+            # (vertices reachable only through overlay edges)
+            dist = oscat(dist, fbits, ov.src_dev, ov.dst_dev,
+                         dev_scalar(level), cap=ov.cap, n_=n)
         c_count = int(st[0])
         # chunk rounds over the shared candidate list (bu_more shape)
         off = None
@@ -972,8 +1038,8 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
             fuse = BU_CHUNK_ROUNDS - rounds
             dist, cand, off, prog = bstep(
                 dist, fbits, cand[:c_cap2], off[:c_cap2], prog,
-                dev_scalar(level), dstT, colstart, degc,
-                c_cap=c_cap2, n_=n, fuse=fuse)
+                dev_scalar(level), dstT, colstart, degc, tbits,
+                c_cap=c_cap2, n_=n, fuse=fuse, masked=masked)
             cand, off = pad(cand), pad(off)
             c_count, rem8 = (int(x) for x in np.asarray(prog))
             rounds += fuse
@@ -981,8 +1047,8 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
             c_cap2 = min(_next_pow2(max(c_count, 2)), cap_n)
             rem_cap = _next_pow2(max(rem8, 2))
             dist = bex(dist, fbits, cand[:c_cap2], off[:c_cap2], prog,
-                       dev_scalar(level), dstT, colstart, degc,
-                       c_cap=c_cap2, p_cap=rem_cap, n_=n)
+                       dev_scalar(level), dstT, colstart, degc, tbits,
+                       c_cap=c_cap2, p_cap=rem_cap, n_=n, masked=masked)
         level += 1
     # jobs still active at max_levels count as completed-at-cap
     if act_h.any():
@@ -1002,8 +1068,17 @@ def frontier_bfs_hybrid(snap, source_dense: int, max_levels: int = 1000,
     the axon tunnel — benches should keep it on device)."""
     import jax.numpy as jnp
 
-    # accept either a GraphSnapshot or a prebuilt device graph dict
-    # (titan_tpu.olap.tpu.graph500.to_device)
+    ov = getattr(snap, "_live_overlay", None) \
+        if not isinstance(snap, dict) else None
+    if ov is not None and not ov.empty:
+        # the direction-optimizing single-source path has no overlay
+        # seam (its head/endgame loops fuse whole level ranges) — the
+        # serving layer routes every BFS through the overlay-aware
+        # batched kernel instead
+        raise RuntimeError(
+            "frontier_bfs_hybrid on a live overlay: use "
+            "frontier_bfs_batched (overlay-aware) or compact the "
+            "overlay first (LiveGraphPlane.compact_if_dirty)")
     g = snap if isinstance(snap, dict) else build_chunked_csr(snap)
     n = g["n"]
     dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]

@@ -224,11 +224,15 @@ def _push_list(kind: str):
         import jax
         import jax.numpy as jnp
 
+        from titan_tpu.models.bfs_hybrid import _bit_of
+
         @functools.partial(jax.jit,
-                           static_argnames=("f_cap", "p_cap", "n_"),
+                           static_argnames=("f_cap", "p_cap", "n_",
+                                            "masked"),
                            donate_argnums=(0, 1))
         def pushl(val, val_exp, flist, lbounds, i, thr, dstT, colstart,
-                  degc, wparams, f_cap: int, p_cap: int, n_: int):
+                  degc, wparams, tbits, f_cap: int, p_cap: int,
+                  n_: int, masked: bool = False):
             p0 = lbounds[i]
             p1 = lbounds[i + 1]
             L = flist.shape[0]
@@ -254,9 +258,13 @@ def _push_list(kind: str):
                 with_owner=True)
             src_val = valv[owner]
             nbr = jnp.take(dstT, cols, axis=1)
+            lane = jnp.arange(8, dtype=jnp.int32)[:, None]
+            slot = cols[None, :] * 8 + lane
+            if masked:
+                # live-overlay tombstones (olap/live): a dead base slot
+                # relaxes nothing — its lane scatters to the drop pad
+                nbr = jnp.where(_bit_of(tbits, slot), n_ + 1, nbr)
             if kind == "sssp":
-                lane = jnp.arange(8, dtype=jnp.int32)[:, None]
-                slot = cols[None, :] * 8 + lane
                 w = _hash_weight_expr(slot, wparams[0], wparams[1])
                 msg = src_val[None, :] + w
             else:
@@ -264,6 +272,45 @@ def _push_list(kind: str):
             return val.at[nbr].min(msg, mode="drop"), val_exp
         return pushl
     return jit_once(f"frontier_pushlist_{kind}", build)
+
+
+def _overlay_relax(kind: str):
+    """Relax every LIVE overlay add-edge with the sources' current
+    values — the delta-COO push pass of the live plane's expansion seam
+    (olap/live). SSSP/WCC are monotone min-fixpoint computations, so
+    extra relaxations are always sound; ``_frontier_run`` calls this
+    after each round's base pushes (one overlay hop per round) and on
+    empty plans, where the returned improvement count decides whether
+    overlay-only progress keeps the loop alive. Overlay edges hash
+    their weights from slots past the base layout (``slot_base + i``,
+    stable under append; a compaction re-slots them with the rebuilt
+    CSR — docs/live.md)."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit,
+                           static_argnames=("cap", "n_"),
+                           donate_argnums=(0,))
+        def relax(val, ov_src, ov_dst, wparams, slot_base, cap: int,
+                  n_: int):
+            s = jnp.minimum(ov_src, n_)    # pad (n+1) reads val[n]=inf
+            src_val = val[s]
+            if kind == "sssp":
+                slot = slot_base + jnp.arange(cap, dtype=jnp.int32)
+                w = _hash_weight_expr(slot, wparams[0], wparams[1])
+                msg = src_val + w
+            else:
+                msg = src_val
+            # improvement detected PRE-scatter (lane-wise msg vs current
+            # target value): no read of the donated buffer after the
+            # update, and >0 iff the scatter changes anything
+            nimp = (msg < val[jnp.minimum(ov_dst, n_)]) \
+                .sum(dtype=jnp.int32)
+            new = val.at[ov_dst].min(msg, mode="drop")
+            return new, nimp
+        return relax
+    return jit_once(f"frontier_overlay_relax_{kind}", build)
 
 
 def _quantize_cap(mass: int, p_full: int) -> int:
@@ -316,7 +363,7 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
                   max_rounds: int, delta: float | None = None,
                   quantile_mass: int = 0, on_round=None,
                   checkpoint=None, start_rounds: int = 0,
-                  bucket_end0: float | None = None):
+                  bucket_end0: float | None = None, overlay=None):
     """Expansion-tracked round loop: one plan readback per round
     (_band_plan — compacted in-band list + mass-balanced segment
     bounds, no n-wide nonzero), then one _push_list dispatch per
@@ -348,6 +395,18 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
     dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
     plan = _band_plan(kind)
     pushl = _push_list(kind)
+    # live-overlay expansion seam (olap/live): tombstoned base slots
+    # are masked out of every push; overlay add-edges relax after each
+    # round's pushes (and on empty plans, where overlay-only progress
+    # keeps the loop alive — see the nf == 0 branch)
+    ov = overlay
+    if ov is None and not isinstance(snap_or_graph, dict):
+        ov = getattr(snap_or_graph, "_live_overlay", None)
+    if ov is not None and ov.empty:
+        ov = None
+    masked = ov is not None and ov.tomb_count > 0
+    has_adds = ov is not None and ov.count > 0
+    relax = _overlay_relax(kind) if has_adds else None
     max_dc = _max_degc(g)
     is_f32 = val.dtype == jnp.float32
     big = float(FINF) if is_f32 else int(IINF)
@@ -368,6 +427,17 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
         p_full = _next_pow2(max(budget + max_dc, 2))
 
     wp = jnp.asarray(np.asarray(wparams, np.float32))
+    tbits = ov.tomb_dev if masked else jnp.zeros((1,), jnp.uint8)
+
+    def _relax(v):
+        return relax(v, ov.src_dev, ov.dst_dev, wp,
+                     dev_scalar(ov.slot_base), cap=ov.cap, n_=n)
+
+    if has_adds and start_rounds == 0 and bucket_end0 is None:
+        # fresh start: seed the overlay's one-hop reach of the initial
+        # values (a source with ONLY overlay edges would otherwise
+        # terminate on its first empty plan)
+        val, _ = _relax(val)
     # the quantile threshold math in _band_plan is float32-only (span
     # floor 1e-30, jnp.nextafter on lo); int-valued kinds (e.g. WCC
     # labels) would trace-error or mis-threshold — fall back to the
@@ -434,6 +504,16 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
             trace.append((0.0 if quantile_mass else float(bucket_end),
                           nf, m8, _time.time(), plan_s))
         if nf == 0 or m8 == 0:
+            if has_adds:
+                # the base plan is dry: only overlay edges can make
+                # progress (e.g. chains through vertices with no base
+                # edges). One relax per round; terminate only when it
+                # improves nothing — then base+overlay are at the
+                # fixpoint together.
+                val, nimp = _relax(val)
+                if int(np.asarray(nimp)) > 0:
+                    rounds += 1
+                    continue
             if float(pmin) >= big * (1 - 1e-6):
                 return val[:n], rounds     # no pending work anywhere
             if quantile_mass:
@@ -480,8 +560,11 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
                 else min(f_bucket, p_cap)
             val, val_exp = pushl(
                 val, val_exp, flist, lbounds, dev_scalar(k),
-                thr_dev, dstT, colstart, degc, wp,
-                f_cap=fk, p_cap=p_cap, n_=n)
+                thr_dev, dstT, colstart, degc, wp, tbits,
+                f_cap=fk, p_cap=p_cap, n_=n, masked=masked)
+        if has_adds:
+            # one overlay hop per round, tracking the base expansion
+            val, _ = _relax(val)
         rounds += 1
     return val[:n], rounds
 
@@ -491,7 +574,8 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
                   delta: float | None = None,
                   quantile_mass: int | None = None,
                   return_device: bool = False, on_round=None,
-                  checkpoint=None, resume: dict | None = None):
+                  checkpoint=None, resume: dict | None = None,
+                  overlay=None):
     """SSSP over hashed edge weights with an expansion-tracked frontier;
     ``delta`` > 0 adds delta-stepping buckets. Returns (dist float32 [n]
     with FINF unreachable, rounds).
@@ -541,12 +625,14 @@ def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
         # nothing has pushed yet: only the source reads as improved
         # (val < val_exp); unreached sit at val == val_exp == FINF
         val_exp = jnp.full((n + 1,), FINF, jnp.float32)
+    if overlay is None and not isinstance(snap_or_graph, dict):
+        overlay = getattr(snap_or_graph, "_live_overlay", None)
     out, rounds = _frontier_run(g, val, val_exp, "sssp",
                                 (min_w, w_range), max_rounds,
                                 delta=delta, quantile_mass=quantile_mass,
                                 on_round=on_round, checkpoint=checkpoint,
                                 start_rounds=start_rounds,
-                                bucket_end0=bucket_end0)
+                                bucket_end0=bucket_end0, overlay=overlay)
     if not return_device:
         out = np.asarray(out)
     return out, rounds
@@ -579,7 +665,8 @@ def _wcc_seed_labels():
 def pagerank_dense(snap_or_graph, iterations: int = 20,
                    damping: float = 0.85, tol: float | None = None,
                    return_device: bool = False, on_round=None,
-                   checkpoint=None, resume: dict | None = None):
+                   checkpoint=None, resume: dict | None = None,
+                   overlay=None):
     """Push-mode PageRank over the chunked CSR via dense window sweeps:
     rank' = (1-d)/n + d * sum over in-edges of rank[src]/outdeg[src]
     (semantics match the pull-mode engine program in models/pagerank.py,
@@ -596,6 +683,22 @@ def pagerank_dense(snap_or_graph, iterations: int = 20,
     so the continuation is bit-equal to an uninterrupted run."""
     import jax.numpy as jnp
 
+    # an explicitly passed view (the serving lease's, frozen at the
+    # job's epoch) overrides the snapshot's latest attached view — the
+    # scheduler compacts before leasing for this kind, so its view is
+    # empty even when later deltas already re-dirtied the plane
+    ov = overlay
+    if ov is None and not isinstance(snap_or_graph, dict):
+        ov = getattr(snap_or_graph, "_live_overlay", None)
+    if ov is not None and not ov.empty:
+        # dense sweeps read contiguous base-CSR column windows — there
+        # is no per-edge seam to mask tombstones or inject adds. The
+        # documented fallback: fold the overlay first (the serving
+        # scheduler does this for 'pagerank'/'dense' kinds).
+        raise RuntimeError(
+            "pagerank_dense on a live overlay: compact the overlay "
+            "first (LiveGraphPlane.compact_if_dirty) — dense window "
+            "sweeps have no overlay seam")
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
     n = g["n"]
@@ -676,7 +779,8 @@ def _pr_finish():
 
 def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
                  return_device: bool = False, on_round=None,
-                 checkpoint=None, resume: dict | None = None):
+                 checkpoint=None, resume: dict | None = None,
+                 overlay=None):
     """Hybrid connected components (symmetrized graphs): peel the seed
     vertex's whole component with one direction-optimized BFS, then run
     min-label propagation over the remaining components only. Returns
@@ -693,6 +797,10 @@ def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
 
     g = snap_or_graph if isinstance(snap_or_graph, dict) \
         else build_chunked_csr(snap_or_graph)
+    if overlay is None and not isinstance(snap_or_graph, dict):
+        overlay = getattr(snap_or_graph, "_live_overlay", None)
+    if overlay is not None and overlay.empty:
+        overlay = None
     n = g["n"]
     if n == 0:
         out = jnp.zeros((0,), jnp.int32)
@@ -703,6 +811,18 @@ def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
         val_exp = jnp.asarray(resume["val_exp"], jnp.int32)
         start_rounds = int(resume["rounds"])
         levels = int(resume.get("levels", 0))
+    elif overlay is not None:
+        # live overlay: the BFS peel has no overlay seam, so skip it
+        # and run pure min-label propagation — every vertex starts at
+        # its own id in improved state. Slower (no giant-component
+        # shortcut) but exact: labels converge to the component minimum
+        # either way, so the result stays bit-equal to a rebuilt
+        # snapshot's frontier_wcc.
+        ids = jnp.arange(n, dtype=jnp.int32)
+        val = jnp.concatenate([ids, jnp.full((1,), IINF, jnp.int32)])
+        val_exp = jnp.concatenate(
+            [ids + 1, jnp.full((1,), IINF, jnp.int32)])
+        levels = 0
     else:
         # seed at the max-degree vertex — on power-law graphs it anchors
         # the giant component, so the BFS peels ~all edge mass
@@ -724,7 +844,8 @@ def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,
     out, rounds = _frontier_run(g, val, val_exp, "wcc", (0.0, 0.0),
                                 max_rounds, on_round=on_round,
                                 checkpoint=checkpoint,
-                                start_rounds=start_rounds)
+                                start_rounds=start_rounds,
+                                overlay=overlay)
     if not return_device:
         out = np.asarray(out)
     return out, rounds + levels

@@ -1,0 +1,31 @@
+"""Live graph plane: serve OLAP traffic while the graph is being written.
+
+The OLTP→OLAP freshness pipeline (ISSUE r9 tentpole; reference seam:
+titan-core's trigger-log/LogProcessor machinery, docs/TitanBus.md §3 —
+rebuilt TPU-native so freshness costs neither a snapshot rebuild nor an
+HBM re-upload):
+
+* ``feed.ChangeFeed`` — tails the durable user trigger log with a
+  resumable named read marker; payloads become columnar
+  ``DeltaBatch``es (cross-instance writers reach the OLAP plane here);
+* ``overlay.DeltaOverlay`` — device-resident padded COO add-buffer +
+  tombstone bitmap over base-CSR edge slots, pow-2 capacity buckets
+  (no recompile on append), HBM-ledger accounted; the frontier kernels
+  consume immutable ``OverlayView``s through their overlay-aware
+  expansion seams (models/frontier.py, models/bfs_hybrid.py);
+* ``compactor.EpochCompactor`` — folds overlay into base when fill or
+  tombstone budget trips, republishing a new epoch to the serving pool;
+* ``plane.LiveGraphPlane`` — orchestration: dual-lane ingest (in-process
+  listener + durable feed), epoch/lease consistency, ``serving.live.*``
+  metrics surfaced by ``GET /live``.
+
+See docs/live.md for the architecture and the freshness/epoch contract.
+"""
+
+from titan_tpu.olap.live.compactor import EpochCompactor
+from titan_tpu.olap.live.feed import ChangeFeed, DeltaBatch
+from titan_tpu.olap.live.overlay import DeltaOverlay, OverlayView
+from titan_tpu.olap.live.plane import LiveGraphPlane
+
+__all__ = ["ChangeFeed", "DeltaBatch", "DeltaOverlay", "OverlayView",
+           "EpochCompactor", "LiveGraphPlane"]

@@ -1,0 +1,72 @@
+"""EpochCompactor: fold the delta overlay back into the base CSR.
+
+Compaction is the live plane's epoch boundary: the overlay's live adds
+and tombstoned base rows are merged into a fresh dst-sorted snapshot
+(``olap/tpu/snapshot.from_arrays`` — the same CSR builder the scan path
+uses), the new epoch is republished to the serving pool (running jobs
+keep their leased (snapshot, overlay-view) pair; new jobs lease the
+merged base with an empty overlay), and only THEN do the device-layout
+caches of the old base die — the acceptance contract that a refresh
+under writes never evicts or re-uploads the base CSR until the
+compactor republishes.
+
+Policy: compact when the overlay's add-buffer fill or its tombstone
+fraction crosses budget (defaults 0.5 / 0.05), when a delta cannot be
+expressed in the overlay at all (vertex-set changes, edges to unknown
+vertices — the general ``apply_changes`` path handles those on the
+merged snapshot), or when the HBM ledger refuses an overlay growth.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: default thresholds — fill is fraction of the CURRENT capacity bucket
+#: (so small overlays compact before jumping buckets), tombstones are a
+#: fraction of base edge rows (dead slots cost gather bandwidth every
+#: round until compacted)
+MAX_FILL = 0.5
+MAX_TOMB_FRACTION = 0.05
+
+
+class EpochCompactor:
+    """Merge policy + merge implementation (host-array work only)."""
+
+    def __init__(self, max_fill: float = MAX_FILL,
+                 max_tomb_fraction: float = MAX_TOMB_FRACTION):
+        self.max_fill = float(max_fill)
+        self.max_tomb_fraction = float(max_tomb_fraction)
+
+    def should_compact(self, overlay) -> bool:
+        if overlay.count == 0 and overlay.tomb_count == 0:
+            return False
+        return (overlay.fill_fraction() >= self.max_fill
+                or overlay.tombstone_fraction() >= self.max_tomb_fraction)
+
+    def merge(self, snapshot, overlay):
+        """Base + overlay → a fresh snapshot over the SAME vertex set
+        (vertex-set changes ride the subsequent ``apply_changes`` call
+        on the merged object). Pure host-array work; the old snapshot's
+        arrays are left untouched for jobs still leasing them."""
+        from titan_tpu.olap.tpu import snapshot as snap_mod
+
+        keep = ~overlay.tomb_row_mask
+        src = snapshot.src[keep]
+        dst = snapshot.dst[keep]
+        labs = snapshot.labels[keep] if snapshot.labels is not None \
+            else None
+        a_src, a_dst, a_lab = overlay.live_adds()
+        if len(a_src):
+            src = np.concatenate([src, a_src])
+            dst = np.concatenate([dst, a_dst])
+            if labs is not None:
+                labs = np.concatenate([labs, a_lab])
+        merged = snap_mod.from_arrays(
+            snapshot.n, src, dst, snapshot.vertex_ids,
+            labels=labs, label_names=snapshot.label_names)
+        # dense vertex-property columns stay aligned (same vertex set);
+        # carry them over so compiled has()/values() keep working
+        merged.vertex_values = dict(snapshot.vertex_values)
+        merged._build_params = dict(snapshot._build_params or {})
+        merged.epoch = snapshot.epoch
+        return merged

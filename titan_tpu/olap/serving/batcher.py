@@ -68,6 +68,19 @@ def _dense_source(snap, params: dict) -> int:
                      "'source_dense'")
 
 
+def _epoch_token(snap, overlay):
+    """Checkpoint-compatibility token: the snapshot epoch, widened with
+    the overlay delta seq when a live overlay is active. Checkpoints
+    resume only on an EXACT match (olap/recovery JobRecovery.latest) —
+    overlay deltas between attempts would otherwise leak stale
+    reachability into the resumed state (tombstones are not monotone),
+    so a changed seq forces a clean restart instead."""
+    e = getattr(snap, "epoch", None)
+    if overlay is not None and not overlay.empty:
+        return [e, overlay.seq]
+    return e
+
+
 def _bfs_result(snap, dist_row: np.ndarray, levels: int, inf: int,
                 params: dict) -> dict:
     reached = int((dist_row < inf).sum())
@@ -95,7 +108,7 @@ class Batcher:
 
     # -- batched BFS --------------------------------------------------------
 
-    def run_bfs_batch(self, jobs: list[Job], snap) -> None:
+    def run_bfs_batch(self, jobs: list[Job], snap, overlay=None) -> None:
         """Execute K BFS jobs as one batched [K, n] device run; each
         job's row is bit-equal to a sequential single-source run. Jobs
         whose source does not resolve fail up front (they never join the
@@ -127,7 +140,7 @@ class Batcher:
             rec = job.recovery
             if rec is not None and job.attempt > 1:
                 ck = rec.latest(kind="bfs",
-                                epoch=getattr(snap, "epoch", None))
+                                epoch=_epoch_token(snap, overlay))
                 if ck is not None:
                     rec.resumed(ck.round)
                 else:
@@ -138,14 +151,15 @@ class Batcher:
                 fresh.append(job)
                 fresh_src.append(src)
         if fresh:
-            self._bfs_group(fresh, fresh_src, snap, None, 0)
+            self._bfs_group(fresh, fresh_src, snap, None, 0,
+                            overlay=overlay)
         for job, src, ck in resumed:
             self._bfs_group([job], [src], snap,
                             np.asarray(ck.arrays["dist"])[None, :],
-                            ck.round)
+                            ck.round, overlay=overlay)
 
     def _bfs_group(self, runnable: list[Job], sources: list[int], snap,
-                   init_dist, start_level: int) -> None:
+                   init_dist, start_level: int, overlay=None) -> None:
         from titan_tpu.models.bfs import INF
         from titan_tpu.models.bfs_hybrid import frontier_bfs_batched
 
@@ -178,6 +192,8 @@ class Batcher:
                     keep[i] = False
             return keep if not keep.all() else None
 
+        token = _epoch_token(snap, overlay)
+
         def checkpoint(level, dist, act):
             for i, job in enumerate(runnable):
                 rec = job.recovery
@@ -185,7 +201,7 @@ class Batcher:
                     rec.save(level,
                              {"dist": np.asarray(dist[i, :n])},
                              kind="bfs",
-                             meta={"epoch": getattr(snap, "epoch", None)})
+                             meta={"epoch": token})
 
         wants_ckpt = any(j.recovery is not None
                          and j.recovery.store is not None
@@ -196,7 +212,8 @@ class Batcher:
                     runnable[0].spec.params.get("max_levels", 1000)),
                 on_level=on_level,
                 init_dist=init_dist, start_level=start_level,
-                checkpoint=checkpoint if wants_ckpt else None)
+                checkpoint=checkpoint if wants_ckpt else None,
+                overlay=overlay)
         except Exception as e:
             for job in runnable:
                 job.fail(f"{type(e).__name__}: {e}")
@@ -213,7 +230,7 @@ class Batcher:
 
     # -- single execution ---------------------------------------------------
 
-    def run_single(self, job: Job, snap) -> None:
+    def run_single(self, job: Job, snap, overlay=None) -> None:
         """One job alone (still async from the caller's view). The
         frontier kinds honor cancellation/timeout at ROUND boundaries
         through ``_frontier_run``'s on_round veto (models/frontier
@@ -248,9 +265,9 @@ class Batcher:
             # bfs delegates wholesale — run_bfs_batch owns its own
             # resume bookkeeping (doing it here too would double-count
             # serving.recovery.resumes / rounds_replayed)
-            self.run_bfs_batch([job], snap)
+            self.run_bfs_batch([job], snap, overlay=overlay)
             return
-        epoch = getattr(snap, "epoch", None)
+        epoch = _epoch_token(snap, overlay)
         ck = None
         if rec is not None and job.attempt > 1 and kind != "callable":
             ck = rec.latest(kind=kind, epoch=epoch)
@@ -294,7 +311,8 @@ class Batcher:
                     delta=params.get("delta"),
                     quantile_mass=params.get("quantile_mass"),
                     max_rounds=int(params.get("max_rounds", 10_000)),
-                    on_round=on_round, checkpoint=ckpt, resume=resume)
+                    on_round=on_round, checkpoint=ckpt, resume=resume,
+                    overlay=overlay)
                 dist = np.asarray(dist)
                 job.complete({"rounds": int(rounds),
                               "reached": int((dist < float(FINF)).sum()),
@@ -316,7 +334,7 @@ class Batcher:
                     snap, iterations=int(params.get("iterations", 20)),
                     damping=float(params.get("damping", 0.85)),
                     tol=params.get("tol"), on_round=on_round,
-                    checkpoint=ckpt, resume=resume)
+                    checkpoint=ckpt, resume=resume, overlay=overlay)
                 job.complete({"iterations": int(iters),
                               "rank": np.asarray(rank)})
             elif kind == "wcc":
@@ -339,7 +357,8 @@ class Batcher:
                               "rounds": ck.round,
                               "levels": ck.meta.get("levels", 0)}
                 lab, rounds = frontier_wcc(snap, on_round=on_round,
-                                           checkpoint=ckpt, resume=resume)
+                                           checkpoint=ckpt, resume=resume,
+                                           overlay=overlay)
                 lab = np.asarray(lab)
                 job.complete({"rounds": int(rounds),
                               "components": int(len(np.unique(lab))),

@@ -77,6 +77,11 @@ class Job:
         # round progress + checkpoint bookkeeping; ``recovery`` is the
         # scheduler-attached JobRecovery (None when disabled)
         self.attempt: int = 1
+        # the graph epoch the job's snapshot lease covered (set by the
+        # scheduler at lease time; live plane leases carry the
+        # compaction epoch + overlay delta seq) — freshness provenance
+        # in the wire envelope
+        self.ran_epoch: Optional[dict] = None
         self.not_before: Optional[float] = None
         self.retries_exhausted: bool = False
         self.last_round: int = 0
@@ -217,6 +222,8 @@ class Job:
             "batch_k": self.batch_k,
             "attempt": self.attempt,
         }
+        if self.ran_epoch is not None:
+            out["epoch"] = self.ran_epoch
         if self.spec.max_retries:
             out["max_retries"] = self.spec.max_retries
         if self.checkpoint_round is not None:

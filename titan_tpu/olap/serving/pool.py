@@ -29,14 +29,20 @@ from typing import Optional, Sequence
 
 class Lease:
     """Context-managed snapshot lease; ``release()`` (or ``with``) must
-    run exactly once."""
+    run exactly once. ``overlay``/``epoch_info`` are set on LIVE leases
+    (olap/live): the overlay view frozen at the same epoch as the
+    snapshot — the consistent pair jobs run against — and the epoch
+    descriptor reported by ``GET /jobs``."""
 
-    __slots__ = ("snapshot", "_release", "_done")
+    __slots__ = ("snapshot", "_release", "_done", "overlay",
+                 "epoch_info")
 
     def __init__(self, snapshot, release):
         self.snapshot = snapshot
         self._release = release
         self._done = False
+        self.overlay = None
+        self.epoch_info = None
 
     def release(self) -> None:
         if not self._done:
@@ -54,12 +60,27 @@ class Lease:
 class SnapshotPool:
     """See module doc. ``graph=None, snapshot=...`` pins one fixed
     snapshot (array-built or externally managed) that is always returned
-    as-is — the epoch machinery needs a source graph."""
+    as-is — the epoch machinery needs a source graph.
 
-    def __init__(self, graph=None, snapshot=None, on_close=None):
+    ``live=`` attaches a ``olap/live.LiveGraphPlane``: acquires whose
+    key matches the plane's (labels, no edge_keys, directed) lease the
+    plane's current (snapshot, overlay-view) pair at a consistent epoch
+    instead of building/refreshing; compactions REPUBLISH — the old base
+    retires when its last lease drops, exactly like the
+    replace-when-leased path. Other keys fall through to the normal
+    build/refresh machinery."""
+
+    def __init__(self, graph=None, snapshot=None, on_close=None,
+                 live=None):
+        if live is not None and graph is None:
+            graph = live.graph
         if graph is None and snapshot is None:
-            raise ValueError("SnapshotPool needs a graph or a snapshot")
+            raise ValueError("SnapshotPool needs a graph, a snapshot "
+                             "or a live plane")
         self.graph = graph
+        self._live = live
+        if live is not None:
+            live._republish = self._live_republish
         self._fixed = snapshot
         self._entries: dict = {}      # key -> current snapshot
         self._leases: dict = {}       # id(snap) -> count
@@ -109,9 +130,42 @@ class SnapshotPool:
 
     # -- acquisition --------------------------------------------------------
 
+    def _live_republish(self, old, new) -> None:
+        """Plane compaction/resync hook: the previous base snapshot
+        leaves the serving plane — retired while leases hold it, closed
+        outright otherwise (on_close drops its HBM ledger entry and
+        device caches either way)."""
+        to_close = None
+        with self._lock:
+            if self._leases.get(id(old), 0) > 0:
+                self._retired[id(old)] = old
+            else:
+                to_close = old
+        if to_close is not None:
+            self._close_snap(to_close)
+
+    def _acquire_live(self, compacted: bool) -> Lease:
+        plane = self._live
+        # plane lock → pool lock is the global order (republish runs
+        # under the plane lock and takes the pool lock); holding it
+        # across the lease keeps the (snapshot, view) pair and the
+        # lease count atomic with any concurrent compaction
+        with plane._lock:
+            if compacted:
+                plane.compact_if_dirty()
+            snap, view, info = plane.lease_state()
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("pool is closed")
+                lease = self._lease_locked(snap)
+                lease.overlay = view
+                lease.epoch_info = info
+                return lease
+
     def acquire(self, labels: Optional[Sequence[str]] = None,
                 edge_keys: Sequence[str] = (),
-                directed: bool = False) -> Lease:
+                directed: bool = False,
+                compacted: bool = False) -> Lease:
         """Lease a snapshot for the given parameters whose epoch covers
         every commit visible before this call.
 
@@ -129,6 +183,8 @@ class SnapshotPool:
         from titan_tpu.olap.tpu import snapshot as snap_mod
 
         key = self.key_of(labels, edge_keys, directed)
+        if self._live is not None and key == self._live.pool_key:
+            return self._acquire_live(compacted)
         e0 = self.graph.mutation_epoch
         with self._lock:
             if self._closed:
@@ -165,9 +221,23 @@ class SnapshotPool:
                 try:
                     snap.refresh()
                 except (RuntimeError, NotImplementedError):
-                    # delta gap / backlog overflow / edge_values:
-                    # epoch-retry via a full rebuild (build() itself
-                    # retries its scan against racing writers)
+                    # delta gap / backlog overflow / edge_values: degrade
+                    # to a full rebuild, NEVER a job failure. With no
+                    # leases out (we hold the key lock, so no new lease
+                    # can appear for this key) the rebuild happens IN
+                    # PLACE — keeping the object identity AND
+                    # re-anchoring its change queue at the rebuilt epoch,
+                    # so a single overflow doesn't force every future
+                    # refresh into a rebuild (ISSUE r9 satellite);
+                    # otherwise retire-and-replace as usual.
+                    with self._lock:
+                        leased = self._leases.get(id(snap), 0) > 0
+                    if not leased:
+                        try:
+                            snap.rebuild_in_place()
+                            continue
+                        except Exception:
+                            pass     # fall through: replace wholesale
                     rebuild_close = snap
                     with self._lock:
                         if self._entries.get(key) is snap:
@@ -181,9 +251,12 @@ class SnapshotPool:
 
     def stats(self) -> dict:
         with self._lock:
-            return {"entries": len(self._entries),
-                    "active_leases": sum(self._leases.values()),
-                    "retired": len(self._retired)}
+            out = {"entries": len(self._entries),
+                   "active_leases": sum(self._leases.values()),
+                   "retired": len(self._retired)}
+        if self._live is not None:
+            out["live_epoch"] = self._live.epoch
+        return out
 
     def close(self) -> None:
         with self._lock:

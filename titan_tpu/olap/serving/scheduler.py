@@ -67,9 +67,18 @@ class JobScheduler:
                  hbm_budget_bytes: float = DEFAULT_BUDGET_BYTES,
                  metrics: Optional[MetricManager] = None,
                  autostart: bool = True,
-                 checkpoint_dir: Optional[str] = None):
-        self.pool = SnapshotPool(graph, snapshot)
+                 checkpoint_dir: Optional[str] = None,
+                 live=None):
+        # live plane (olap/live): jobs lease (snapshot, overlay) pairs
+        # at a consistent epoch instead of refresh/rebuild churn; the
+        # scheduler OWNS the plane's lifecycle once attached (close()
+        # closes it) and lends it the HBM ledger so overlay growth is
+        # admission-controlled
+        self.live = live
+        self.pool = SnapshotPool(graph, snapshot, live=live)
         self.ledger = HBMLedger(hbm_budget_bytes, on_evict=self._evict)
+        if live is not None and live._ledger is None:
+            live._ledger = self.ledger
         self.batcher = Batcher(max_batch=max_batch)
         self.max_batch = max_batch
         self._metrics = metrics or MetricManager.instance()
@@ -127,6 +136,8 @@ class JobScheduler:
                 job.fail("scheduler closed", permanent=True)
                 self._finalize_metrics(job)
         self.pool.close()
+        if self.live is not None:
+            self.live.close()
 
     def _evict(self, key) -> None:
         """HBM eviction: drop the snapshot's cached device CSR (arrays
@@ -223,6 +234,11 @@ class JobScheduler:
     def jobs(self) -> list[Job]:
         with self._cv:
             return list(self._jobs.values())
+
+    def live_stats(self) -> Optional[dict]:
+        """The live plane's freshness/overlay/compaction stats
+        (``GET /live``); None when no plane is attached."""
+        return self.live.stats() if self.live is not None else None
 
     def stats(self) -> dict:
         with self._cv:
@@ -385,14 +401,25 @@ class JobScheduler:
             if program is not None and hasattr(program, "edge_keys"):
                 edge_keys = tuple(program.edge_keys())
         try:
+            # dense window sweeps (pagerank / DenseProgram) have no
+            # overlay seam: the live pool folds the overlay into the
+            # base BEFORE leasing for these kinds (the documented
+            # compact-before-run fallback, models/frontier.py)
             lease = self.pool.acquire(labels=spec.labels,
                                       edge_keys=edge_keys,
-                                      directed=spec.directed)
+                                      directed=spec.directed,
+                                      compacted=spec.kind in
+                                      ("pagerank", "dense"))
         except Exception as e:
             for job in group:
                 job.fail(f"snapshot: {type(e).__name__}: {e}")
             return
         with lease as snap:
+            overlay = lease.overlay
+            epoch_info = lease.epoch_info \
+                or {"epoch": getattr(snap, "epoch", 0)}
+            for job in group:
+                job.ran_epoch = epoch_info
             ledger_key = id(snap)
             try:
                 self.ledger.reserve(ledger_key, snapshot_csr_bytes(snap))
@@ -403,8 +430,10 @@ class JobScheduler:
             self._evictable.setdefault(ledger_key, snap)
             try:
                 if len(group) > 1 or batch_key(spec) is not None:
-                    self.batcher.run_bfs_batch(group, snap)
+                    self.batcher.run_bfs_batch(group, snap,
+                                               overlay=overlay)
                 else:
-                    self.batcher.run_single(group[0], snap)
+                    self.batcher.run_single(group[0], snap,
+                                            overlay=overlay)
             finally:
                 self.ledger.unpin(ledger_key)

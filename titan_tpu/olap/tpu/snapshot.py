@@ -138,6 +138,45 @@ class GraphSnapshot:
         self.epoch = new_epoch
         return stats
 
+    def rebuild_in_place(self) -> None:
+        """Full store re-scan adopted into THIS object: the recovery
+        path when delta refresh is unsound (listener overflow, delta
+        gap, extracted edge_values). The existing change queue is
+        RE-ANCHORED at the rebuilt epoch — cleared, overflow flag
+        reset, atomically with the scan's epoch verification — so
+        later refresh()es take the delta path again instead of being
+        forced into a rebuild forever (ISSUE r9 satellite). Callers
+        must guarantee no live device run is reading the arrays (the
+        SnapshotPool only takes this path with zero active leases)."""
+        g = self._graph
+        if g is None:
+            raise RuntimeError("snapshot has no source graph "
+                               "(built from_arrays or closed)")
+        p = self._build_params or {}
+        fresh = build(g, labels=p.get("labels"),
+                      edge_keys=p.get("edge_keys", ()),
+                      directed=p.get("directed", True),
+                      _reuse_listener=(self._listener_token,
+                                       self._listener))
+        self.n = fresh.n
+        self.vertex_ids = fresh.vertex_ids
+        self.src, self.dst = fresh.src, fresh.dst
+        self.indptr_in = fresh.indptr_in
+        self.out_degree = fresh.out_degree
+        self.edge_values = fresh.edge_values
+        self.labels = fresh.labels
+        self.label_names = fresh.label_names
+        # the vertex set may have changed arbitrarily: every dense
+        # column and derived device layout is invalid
+        self.vertex_values.clear()
+        self._invalidate_layout_caches()
+        self.epoch = fresh.epoch
+        # fresh shares our listener (reused, not subscribed) — detach it
+        # so fresh's GC/close cannot unregister the queue we keep using
+        fresh._graph = None
+        fresh._listener = None
+        fresh._listener_token = 0
+
     def apply_changes(self, payloads: list, schema, idm) -> dict:
         """Apply change payloads (core/changes.change_payload dicts — from
         the in-process listener or deserialized from the user trigger
@@ -215,19 +254,22 @@ class GraphSnapshot:
             else np.zeros(len(src_ids), np.int32)
         keep = np.ones(len(src_ids), bool)
         if removed_edges:
-            # drop ONE row per removed relation (parallel edges are
-            # distinct relations, each contributing one row [+reverse])
+            # drop ONE row per removed relation per direction (parallel
+            # edges are distinct relations, each contributing one row
+            # [+reverse]). Undirected snapshots hold BOTH rows of every
+            # relation, so each removal is seeded under both keys —
+            # matching one forward AND one reverse row (the old
+            # rkey-fallback matched only whichever row scanned first,
+            # leaving the mirror row behind and silently
+            # de-symmetrizing the CSR)
             from collections import Counter
             want = Counter(removed_edges)
+            if not directed:
+                want.update((d, s, lb) for s, d, lb in removed_edges)
             for i in range(len(src_ids)):
                 key = (int(src_ids[i]), int(dst_ids[i]), int(labs[i]))
-                rkey = (int(dst_ids[i]), int(src_ids[i]), int(labs[i]))
                 if want.get(key, 0) > 0:
                     want[key] -= 1
-                    keep[i] = False
-                elif not directed and want.get(rkey, 0) > 0:
-                    # symmetrized snapshots hold the reverse row too
-                    want[rkey] -= 1
                     keep[i] = False
         if dead_vids:
             dead = np.asarray(sorted(dead_vids), np.int64)
@@ -582,12 +624,19 @@ def _native_classify(graph, col_buf, offs, entry_row_a, row_vids_raw,
 
 def build(graph, labels: Optional[Sequence[str]] = None,
           edge_keys: Sequence[str] = (),
-          directed: bool = True) -> GraphSnapshot:
+          directed: bool = True,
+          _reuse_listener: Optional[tuple] = None) -> GraphSnapshot:
     """Scan the edgestore and build the snapshot.
 
     ``labels``: restrict to these edge labels (None = all user labels).
     ``edge_keys``: edge property names to extract into aligned arrays.
     ``directed=False`` adds the reverse of every edge (symmetrize).
+    ``_reuse_listener``: a ``(token, ChangeQueue)`` pair to RE-ANCHOR at
+    the scan-verified epoch instead of subscribing a fresh queue —
+    ``rebuild_in_place()``'s seam: the queue is cleared and its
+    overflow flag reset under the same commit-lock window that proves
+    the scan saw a committed prefix, so delta refresh resumes soundly
+    after an overflow-forced rebuild.
     """
     idm = graph.idm
     schema = graph.schema
@@ -638,6 +687,16 @@ def build(graph, labels: Optional[Sequence[str]] = None,
         finally:
             btx.commit()
 
+    def _anchor_locked():
+        """Under the commit lock with the scan verified: attach the
+        listener — a fresh subscription, or the caller's existing queue
+        re-anchored (same atomicity guarantee either way)."""
+        if _reuse_listener is not None:
+            tok, rq = _reuse_listener
+            rq.reanchor()
+            return tok, rq
+        return graph._subscribe_locked()
+
     token = q = None
     for attempt in range(3):
         # final attempt scans while HOLDING the commit lock: writers are
@@ -649,11 +708,11 @@ def build(graph, labels: Optional[Sequence[str]] = None,
             epoch0 = graph.mutation_epoch
             vertex_id_list, srcs, dsts, labs, ev = _scan_once()
             if attempt == 2:
-                token, q = graph._subscribe_locked()
+                token, q = _anchor_locked()
                 break
         with graph._commit_lock:
             if graph.mutation_epoch == epoch0:
-                token, q = graph._subscribe_locked()
+                token, q = _anchor_locked()
                 break
     assert token is not None
 
@@ -690,5 +749,8 @@ def build(graph, labels: Optional[Sequence[str]] = None,
     snap.epoch = epoch0
     snap._graph = graph
     snap._listener_token, snap._listener = token, q
-    snap._build_params = {"label_ids": label_ids, "directed": directed}
+    snap._build_params = {"label_ids": label_ids, "directed": directed,
+                          "labels": (tuple(labels)
+                                     if labels is not None else None),
+                          "edge_keys": tuple(edge_keys)}
     return snap

@@ -25,7 +25,15 @@ Endpoints:
                       opt into the recovery plane (olap/recovery —
                       RETRYING + resume-from-checkpoint; checkpoints
                       need a scheduler with checkpoint_dir set).
-  GET    /jobs      — scheduler stats + job summaries
+  GET    /jobs      — scheduler stats + job summaries (each job's
+                      ``epoch`` records the graph state it ran at —
+                      live-plane leases carry compaction epoch +
+                      overlay delta seq)
+  GET    /live      — live graph plane stats (olap/live): freshness
+                      lag (epochs/seconds), overlay fill + tombstone
+                      fraction, compaction/resync/backpressure
+                      counters, apply/compact latency percentiles;
+                      {"enabled": false} without a live scheduler
   GET    /jobs/<id> — job status/result/metrics envelope (incl. attempt
                       / checkpoint_round / rounds_replayed / retry_at
                       for jobs on the recovery plane)
@@ -259,6 +267,15 @@ class GraphServer:
                     self._send(200, {
                         "stats": sched.stats(),
                         "jobs": [j.to_wire() for j in sched.jobs()]})
+                elif self.path == "/live":
+                    # live plane observability (olap/live): freshness
+                    # lag, overlay fill, compaction/backpressure
+                    # counters — serving.live.* as one JSON envelope
+                    live = server.scheduler().live_stats()
+                    if live is None:
+                        self._send(200, {"enabled": False})
+                    else:
+                        self._send(200, {"enabled": True, **live})
                 elif self.path.startswith("/jobs/"):
                     job = server.scheduler().get(
                         self.path[len("/jobs/"):])
