@@ -68,6 +68,7 @@ _EST = {
                                    # scale fallback below re-prices
     "bfs_heavy": (120,     11.6),  # 2 reps ~10s each + compiles
     "live_refresh": (90,   0.3),   # host-array merges + one s20 upload
+    "serving":   (90,      0.1),   # small-graph batched BFS + retry
 }
 # nominal fast-day H2D rate (GB/s): bfs26's 9GB uploaded in 16.35s
 # (BENCH_r05); the headline stage's measured upload re-prices this
@@ -565,6 +566,97 @@ def live_refresh_stage(rep: Report, scale: int) -> None:
     rep.emit()
 
 
+def serving_stage(rep: Report, scale: int) -> None:
+    """ISSUE r10 evidence stage (ROADMAP item 5b/5d): the serving and
+    recovery planes as FIRST-CLASS metric lines in the driver artifact —
+    ``serving.batch.occupancy`` + job latency at K=8 vs K=1, recovery
+    replay cost (checkpointed retry: rounds replayed + checkpoint
+    commit latency), and the trace digest showing where a fused job's
+    time went. Runs the real JobScheduler/Batcher/recovery stack on a
+    synthetic graph (CPU-meaningful; a chip day re-captures with the
+    tunnel in the loop)."""
+    import tempfile
+
+    from titan_tpu.obs.tracing import trace_summary
+    from titan_tpu.olap.api import JobSpec
+    from titan_tpu.olap.recovery import FaultPlan
+    from titan_tpu.olap.serving.scheduler import JobScheduler
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    from titan_tpu.utils.metrics import MetricManager
+
+    rng = np.random.default_rng(42)
+    n = 1 << scale
+    m = n * 8
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    snap = snap_mod.from_arrays(n, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+    metrics = MetricManager()        # isolated: bench-only lines
+    with tempfile.TemporaryDirectory() as ckdir:
+        sched = JobScheduler(snapshot=snap, metrics=metrics,
+                             autostart=False, checkpoint_dir=ckdir)
+        try:
+            # K=8 fused batch (paused scheduler pins the composition)
+            sources = rng.integers(0, n, 8)
+            t0 = time.time()
+            batch = [sched.submit(JobSpec(
+                kind="bfs", params={"source_dense": int(s)}))
+                for s in sources]
+            sched.start()
+            for j in batch:
+                j.wait(120)
+            k8_s = time.time() - t0
+            # K=1 reference on the warm kernel
+            t0 = time.time()
+            j1 = sched.submit(JobSpec(kind="bfs",
+                                      params={"source_dense": 0}))
+            j1.wait(120)
+            k1_s = time.time() - t0
+            # recovery replay cost: crash at round 2 with per-round
+            # checkpoints → the retry resumes instead of restarting
+            jr = sched.submit(JobSpec(
+                kind="bfs",
+                params={"source_dense": int(sources[0]),
+                        "faults": FaultPlan(crash_at_round=2)},
+                max_retries=1, checkpoint_every=1))
+            jr.wait(120)
+            occ = metrics.histogram("serving.batch.occupancy").to_dict()
+            lat = metrics.histogram("serving.job.latency_ms").to_dict()
+            rep.detail["serving"] = {
+                "scale": scale, "edges_sym": 2 * m,
+                "batch_occupancy": occ,
+                "job_latency_ms": lat,
+                "queue_ms": metrics.histogram(
+                    "serving.job.queue_ms").to_dict(),
+                "k8_batch_wall_s": round(k8_s, 3),
+                "k1_wall_s": round(k1_s, 3),
+                # amortization evidence: wall clock per job in the
+                # fused batch vs the single run
+                "k8_per_job_over_k1_x": round(
+                    (k8_s / 8) / max(k1_s, 1e-9), 3),
+                "recovery": {
+                    "status": jr.state.value,
+                    "attempts": jr.attempt,
+                    "rounds_replayed": metrics.counter_value(
+                        "serving.recovery.rounds_replayed"),
+                    "resumes": metrics.counter_value(
+                        "serving.recovery.resumes"),
+                    "retries": metrics.counter_value(
+                        "serving.recovery.retries"),
+                    "checkpoints": metrics.counter_value(
+                        "serving.recovery.checkpoints"),
+                    "checkpoint_ms": metrics.histogram(
+                        "serving.recovery.checkpoint_ms").to_dict(),
+                },
+                "trace_k8_job": trace_summary(sched.tracer,
+                                              batch[0].id),
+                "trace_retried_job": trace_summary(sched.tracer, jr.id),
+            }
+        finally:
+            sched.close()
+    rep.emit()
+
+
 def bfs_heavy_stage(rep: Report) -> None:
     """BASELINE row 5: Twitter-2010-class (1.5B-edge) single-chip BFS.
     The dataset itself is unreachable in-image (zero egress), so the
@@ -872,6 +964,11 @@ def main() -> None:
         # other evidence stages
         ("live_refresh", lambda: live_refresh_stage(
             rep, 20 if on_accel else min(headline_scale, 14))),
+        # serving/recovery evidence (ISSUE r10): batch occupancy +
+        # latency K=8 vs K=1, recovery replay cost, trace digest —
+        # first-class metric lines next to live_refresh's
+        ("serving", lambda: serving_stage(
+            rep, 16 if on_accel else min(headline_scale, 12))),
         # the sharded-overhead stage also times the plain hybrid at the
         # warm scale, so it outranks the standalone warm stage when the
         # budget is tight

@@ -17,3 +17,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# persistent XLA compile cache, shared with bench.py (.bench_cache/xla):
+# serial-CPU tier-1 is budgeted (870 s) and DOMINATED by XLA compiles,
+# not compute — a warm cache cuts the suite by minutes. Threshold 0:
+# test-scale kernels compile fast individually but number in the
+# hundreds, so even sub-second entries pay for themselves.
+from titan_tpu.utils.jitcache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+try:
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+except Exception:
+    pass
+

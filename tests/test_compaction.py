@@ -187,14 +187,16 @@ def test_round_loop_modules_are_nonzero_free():
     (ISSUE r7): its batched [K, n] round loops — and any future kernel
     code under olap/serving/ — must use the compaction primitives too;
     (ISSUE r8) to olap/recovery/, whose checkpoint callbacks run
-    INSIDE the round loops; and (ISSUE r9) to olap/live/, whose
-    overlay views feed per-round expansion passes."""
+    INSIDE the round loops; (ISSUE r9) to olap/live/, whose
+    overlay views feed per-round expansion passes; and (ISSUE r10) to
+    obs/, whose tracing hooks run at every round boundary."""
     import importlib
     import inspect
     import io
     import pkgutil
     import tokenize
 
+    import titan_tpu.obs as obs_pkg
     import titan_tpu.olap.live as live_pkg
     import titan_tpu.olap.recovery as recovery_pkg
     import titan_tpu.olap.serving as serving_pkg
@@ -212,9 +214,13 @@ def test_round_loop_modules_are_nonzero_free():
         importlib.import_module(f"titan_tpu.olap.live.{m.name}")
         for m in pkgutil.iter_modules(live_pkg.__path__)]
     assert len(live_mods) >= 4      # feed/overlay/compactor/plane
+    obs_mods = [
+        importlib.import_module(f"titan_tpu.obs.{m.name}")
+        for m in pkgutil.iter_modules(obs_pkg.__path__)]
+    assert len(obs_mods) >= 2       # tracing/promexport
 
     for mod in (frontier, bfs_hybrid, bfs_hybrid_sharded,
-                *serving_mods, *recovery_mods, *live_mods):
+                *serving_mods, *recovery_mods, *live_mods, *obs_mods):
         src = inspect.getsource(mod)
         calls = [
             (tok.start[0], line)

@@ -1,0 +1,92 @@
+"""Metric-name doc-drift guard (ISSUE r10 satellite).
+
+Every ``serving.*`` / ``serving.live.*`` / ``serving.recovery.*``
+metric name created in code must appear in a docs/monitoring.md table,
+and every name documented there must exist in code — so the tables
+stop rotting as planes grow.
+
+The code scan finds quoted metric-name literals (all real names have
+>= 3 dot components, which screens out prefix constants like
+``"serving.recovery"``); the two templated families are expanded from
+the SAME constants the code iterates (``JobScheduler._STATE_COUNTER``,
+``plane._LIVE_COUNTERS``), and recovery/store.py's prefix-built names
+are resolved against its default prefix.
+"""
+
+import os
+import re
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "titan_tpu")
+_DOC = os.path.join(_REPO, "docs", "monitoring.md")
+
+# quoted literal with >= 3 dot-components under serving.*; {x} keeps
+# f-string placeholders visible for template expansion
+_LITERAL = re.compile(
+    r"""["']f?(serving\.[a-z0-9_]+\.[a-z0-9_.{}]+)["']""")
+_FSTRING = re.compile(
+    r"""f["'](serving\.[a-z0-9_]+\.[a-z0-9_.{}]+)["']""")
+# names recovery/store.py builds off its configurable prefix (default
+# "serving.recovery")
+_PREFIXED = re.compile(r"""f["']\{self\._prefix\}\.([a-z0-9_]+)["']""")
+# a table row's first column: | `serving.x.y` | ... |
+_DOC_ROW = re.compile(r"^\|\s*`(serving\.[a-z0-9_.]+)`\s*\|",
+                      re.MULTILINE)
+
+
+def _code_metric_names() -> set:
+    from titan_tpu.olap.live.plane import _LIVE_COUNTERS
+    from titan_tpu.olap.serving.scheduler import JobScheduler
+
+    expansions = {
+        "serving.jobs.{name}": [
+            f"serving.jobs.{v}"
+            for v in JobScheduler._STATE_COUNTER.values()],
+        "serving.live.{k}": [f"serving.live.{k}"
+                             for k in _LIVE_COUNTERS],
+    }
+    names: set = set()
+    for dirpath, dirnames, filenames in os.walk(_PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                src = f.read()
+            for m in set(_LITERAL.findall(src)) | set(
+                    _FSTRING.findall(src)):
+                if "{" in m:
+                    got = expansions.get(m)
+                    assert got is not None, (
+                        f"{fn}: templated metric name {m!r} has no "
+                        f"registered expansion — add it to this test "
+                        f"(and docs/monitoring.md)")
+                    names.update(got)
+                else:
+                    names.add(m)
+            for m in _PREFIXED.findall(src):
+                names.add(f"serving.recovery.{m}")
+    return names
+
+
+def _doc_metric_names() -> set:
+    with open(_DOC) as f:
+        return set(_DOC_ROW.findall(f.read()))
+
+
+def test_every_code_metric_documented_and_vice_versa():
+    code = _code_metric_names()
+    docs = _doc_metric_names()
+    # sanity: the scan actually found all three families
+    for family in ("serving.jobs.", "serving.live.",
+                   "serving.recovery."):
+        assert any(n.startswith(family) for n in code), (family, code)
+    missing_from_docs = code - docs
+    assert not missing_from_docs, (
+        "metric names created in code but absent from a "
+        "docs/monitoring.md table: "
+        f"{sorted(missing_from_docs)}")
+    stale_in_docs = docs - code
+    assert not stale_in_docs, (
+        "metric names documented in docs/monitoring.md but no longer "
+        f"created anywhere in code: {sorted(stale_in_docs)}")

@@ -35,21 +35,75 @@ def test_counter_and_timer_basics(metrics):
     assert t.min_ns == 1_000_000 and t.max_ns == 3_000_000
     assert t.mean_ns == 2_000_000
     snap = metrics.snapshot()
-    assert snap["a.b"] == 5
+    assert snap["a.b"] == {"type": "counter", "count": 5}
+    assert snap["a.t"]["type"] == "timer"
     assert snap["a.t"]["count"] == 2
     text = metrics.report_console()
     assert "a.b: 5" in text
 
 
+def test_snapshot_schema_unified_across_kinds(metrics):
+    """ISSUE r10 satellite: every metric kind reports through ONE
+    snapshot shape — a dict with type + count + the kind's stats (the
+    old schema was a bare int for counters, which made every consumer
+    type-sniff and silently dropped timer stats from uniform paths)."""
+    metrics.counter("c").inc(3)
+    metrics.timer("t").update(2_000_000)
+    metrics.histogram("h").update(1.5)
+    snap = metrics.snapshot()
+    assert {v["type"] for v in snap.values()} == {"counter", "timer",
+                                                  "histogram"}
+    for v in snap.values():
+        assert "count" in v
+    assert snap["t"]["mean_ms"] == 2.0 and snap["t"]["max_ms"] == 2.0
+    assert snap["h"]["p50"] == 1.5 and snap["h"]["samples"] == 1
+    assert snap["h"]["total"] == 1.5
+
+
 def test_csv_report(metrics, tmp_path):
+    """One STABLE header across all three metric kinds (ISSUE r10: the
+    old writer reused timer column names for histogram raw stats)."""
     metrics.counter("x").inc(2)
     metrics.timer("y").update(5_000_000)
+    metrics.histogram("z").update(4.0)
     path = tmp_path / "metrics.csv"
     metrics.report_csv(str(path))
-    lines = path.read_text().strip().splitlines()
-    assert lines[0].startswith("metric,")
-    assert any(line.startswith("x,2") for line in lines)
-    assert any(line.startswith("y,1") for line in lines)
+    import csv as _csv
+    rows = list(_csv.reader(open(path)))
+    assert rows[0] == list(MetricManager.CSV_HEADER)
+    by_name = {r[0]: r for r in rows[1:]}
+    assert by_name["x"][1] == "counter" and by_name["x"][2] == "2"
+    assert by_name["y"][1] == "timer" and by_name["y"][2] == "1"
+    assert float(by_name["y"][3]) == 5.0            # mean (ms)
+    assert by_name["z"][1] == "histogram"
+    assert float(by_name["z"][6]) == 4.0            # p50
+    # every row has exactly the header's width — no ragged columns
+    assert all(len(r) == len(rows[0]) for r in rows[1:])
+
+
+def test_histogram_reservoir_deterministic_under_seed():
+    """ISSUE r10 satellite: reservoir sampling must be reproducible —
+    same seed + same update sequence = identical percentiles even past
+    the reservoir capacity (no process-global RNG), and ``to_dict``
+    reports how many samples back the estimate."""
+    from titan_tpu.utils.metrics import Histogram
+
+    def fill(h):
+        for i in range(300):
+            h.update(float(i % 97))
+        return h
+
+    a = fill(Histogram(max_samples=64, seed=7))
+    b = fill(Histogram(max_samples=64, seed=7))
+    assert a.to_dict() == b.to_dict()
+    assert a.to_dict()["samples"] == 64
+    assert a.count == 300 and a.to_dict()["count"] == 300
+    # the default seed is itself fixed: two default instances agree
+    c, d = fill(Histogram(max_samples=64)), fill(Histogram(max_samples=64))
+    assert c.to_dict() == d.to_dict()
+    # a different seed keeps a different (still uniform) reservoir
+    e = fill(Histogram(max_samples=64, seed=8))
+    assert e._samples != a._samples
 
 
 def test_instrumented_store_counts_ops(metrics):
@@ -273,6 +327,41 @@ def test_graph_wires_reporters_from_config(tmp_path):
     assert _os.path.exists(d + "/metrics.csv")
     # close() stopped the thread
     assert not g._reporters[0]._thread.is_alive()
+
+
+def test_reporter_stop_during_inflight_report_no_deadlock_or_double():
+    """ISSUE r10 satellite: stop() racing an in-flight report_now must
+    neither deadlock (stop joins the thread while emit is blocked) nor
+    double-report (the in-flight emit completes and counts ONCE; any
+    report_now after stop is a no-op)."""
+    import threading as _threading
+    import time as _time
+
+    from titan_tpu.utils.metrics import MetricManager, ScheduledReporter
+
+    entered = _threading.Event()
+    release = _threading.Event()
+
+    def emit(manager, ts):
+        entered.set()
+        assert release.wait(10), "test gate never released"
+
+    m = MetricManager()
+    r = ScheduledReporter(m, 0.01, emit, "race")
+    assert entered.wait(5), "reporter never fired"
+
+    stopper = _threading.Thread(target=r.stop)
+    stopper.start()
+    _time.sleep(0.05)            # stop() is now joining the blocked emit
+    assert stopper.is_alive()    # ...not deadlocked, just waiting
+    release.set()
+    stopper.join(10)
+    assert not stopper.is_alive(), "stop() deadlocked on in-flight emit"
+    assert r.stopped and not r._thread.is_alive()
+    assert r.reports == 1, "in-flight report must count exactly once"
+    # post-stop flush attempts are no-ops, not duplicate reports
+    r.report_now()
+    assert r.reports == 1 and r.errors == 0
 
 
 def test_start_reporters_dedups_per_manager_and_sink():

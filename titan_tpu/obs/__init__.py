@@ -1,0 +1,22 @@
+"""Observability plane: span tracing + Prometheus exposition.
+
+The cross-cutting layer the serving stack reports through (reference
+seam: titan-core's ``util.stats`` MetricManager instrumentation around
+every backend call, SURVEY §2 — extended here with Dapper-style
+span-per-superstep tracing, which the reference never had but a
+multi-chip scheduler cannot be debugged without):
+
+* ``tracing`` — explicit start/end spans with parent links, an
+  injectable clock for deterministic tests, and a bounded ring-buffer
+  journal per trace. Pure host-side bookkeeping: the kernels' existing
+  round-boundary host callbacks feed it, never device code.
+* ``promexport`` — renders the ``utils.metrics`` registry (counters /
+  timers / histograms) as Prometheus text exposition, served by
+  ``GET /metrics`` on the HTTP server.
+
+docs/observability.md documents the span model and endpoints.
+"""
+
+from titan_tpu.obs.promexport import CONTENT_TYPE, render_prometheus  # noqa: F401
+from titan_tpu.obs.tracing import (NULL_SPAN, Span, TraceHandle,  # noqa: F401
+                                   Tracer, trace_summary)

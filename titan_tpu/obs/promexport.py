@@ -1,0 +1,82 @@
+"""Prometheus text exposition for the ``utils.metrics`` registry.
+
+Renders every registered counter / timer / histogram in the exposition
+format (version 0.0.4 — the plaintext protocol every Prometheus scraper
+speaks), served by ``GET /metrics`` on the HTTP server:
+
+* counters → ``# TYPE <name> counter`` + one sample (names in
+  ``GAUGE_COUNTERS`` — bidirectional bookkeeping like queue depth —
+  render as gauges instead);
+* timers   → a ``<name>_seconds`` summary (``_count`` / ``_sum``) plus
+  ``<name>_seconds_max`` as a companion gauge — Prometheus summaries
+  don't carry min/max, and the max is the number an SLO page wants;
+* histograms → a summary with ``quantile="0.5"`` / ``"0.95"`` labels
+  (the reservoir's nearest-rank percentiles) + ``_count`` / ``_sum``.
+
+Metric names are sanitized to the Prometheus grammar (dots and every
+other illegal character become ``_``); the rendering is pure host-side
+string work off a single ``snapshot()`` — one registry pass per scrape,
+no locks held while writing the response.
+"""
+
+from __future__ import annotations
+
+import re
+
+from titan_tpu.utils.metrics import MetricManager
+
+#: the scrape response content type (text exposition format 0.0.4)
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: registry Counters that move in BOTH directions (current-level
+#: bookkeeping, e.g. queue depth inc/dec) — exported as Prometheus
+#: gauges, since rate()/increase() over a "counter" would read every
+#: decrement as a counter reset
+GAUGE_COUNTERS = frozenset({"serving.queue.depth"})
+
+_ILLEGAL = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(name: str) -> str:
+    """Metric name → Prometheus grammar ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    out = _ILLEGAL.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _num(v: float) -> str:
+    """Sample value formatting: integers stay integral, floats use
+    repr-precision (Prometheus parses both)."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(manager: MetricManager) -> str:
+    """One scrape body for every metric in ``manager`` (trailing
+    newline included, as the exposition format requires)."""
+    lines: list[str] = []
+    for name, val in manager.snapshot().items():
+        kind = val.get("type")
+        if kind == "counter":
+            n = sanitize(name)
+            ptype = "gauge" if name in GAUGE_COUNTERS else "counter"
+            lines.append(f"# TYPE {n} {ptype}")
+            lines.append(f"{n} {_num(val['count'])}")
+        elif kind == "timer":
+            n = sanitize(name) + "_seconds"
+            lines.append(f"# TYPE {n} summary")
+            lines.append(f"{n}_count {_num(val['count'])}")
+            lines.append(f"{n}_sum {_num(val['total_ms'] / 1e3)}")
+            lines.append(f"# TYPE {n}_max gauge")
+            lines.append(f"{n}_max {_num(val['max_ms'] / 1e3)}")
+        elif kind == "histogram":
+            n = sanitize(name)
+            lines.append(f"# TYPE {n} summary")
+            lines.append(f'{n}{{quantile="0.5"}} {_num(val["p50"])}')
+            lines.append(f'{n}{{quantile="0.95"}} {_num(val["p95"])}')
+            lines.append(f"{n}_count {_num(val['count'])}")
+            lines.append(f"{n}_sum {_num(val['total'])}")
+    return "\n".join(lines) + "\n" if lines else "\n"

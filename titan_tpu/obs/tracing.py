@@ -1,0 +1,300 @@
+"""Lightweight span tracer for the serving/kernel/recovery planes.
+
+Dapper-style explicit spans: the scheduler opens a trace per job (the
+trace id IS the job id), execution layers attach child spans through
+the job's ``TraceHandle``, and ``GET /trace?job=<id>`` renders the tree.
+Design constraints (ISSUE r10):
+
+* **host-only** — spans are plain host timestamps taken at seams that
+  already exist (round-boundary callbacks, checkpoint hooks); nothing
+  here adds device collectives or syncs inside jitted code;
+* **bounded** — each trace is a ring buffer of ``max_spans`` spans
+  (oldest non-root spans drop first, counted in ``dropped_spans``) and
+  the tracer holds at most ``max_traces`` traces (oldest evicted), so a
+  long-lived server cannot leak memory through its own telemetry;
+* **deterministic tests** — the clock is injectable;
+* **removable** — a disabled tracer (``Tracer(enabled=False)``, or
+  ``JobScheduler(tracing=False)`` / ``TITAN_TPU_TRACING=0``) returns a
+  shared no-op span from every call and records nothing; execution
+  layers additionally skip their hooks when ``job.trace is None``, so
+  the per-round cost of tracing-off is one attribute check.
+
+Thread-safety: journal mutation is lock-guarded; ``Span.end`` mutates
+only the span object (single writer — the layer that started it).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Optional
+
+
+class Span:
+    """One timed operation. ``attrs`` carry the seam's payload (frontier
+    size, K, checkpoint round, ...); ``parent_id`` links the tree."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "t_start",
+                 "t_end", "attrs")
+
+    def __init__(self, trace_id: str, span_id: int,
+                 parent_id: Optional[int], name: str, t_start: float,
+                 attrs: Optional[dict]):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.t_start = t_start
+        self.t_end: Optional[float] = None
+        self.attrs = attrs
+
+    def set(self, **attrs) -> "Span":
+        if attrs:
+            if self.attrs is None:
+                self.attrs = {}
+            self.attrs.update(attrs)
+        return self
+
+    @property
+    def open(self) -> bool:
+        return self.t_end is None
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        if self.t_end is None:
+            return None
+        return (self.t_end - self.t_start) * 1e3
+
+    def to_dict(self) -> dict:
+        out = {"span": self.span_id, "name": self.name,
+               "start": self.t_start, "end": self.t_end}
+        if self.parent_id is not None:
+            out["parent"] = self.parent_id
+        d = self.duration_ms
+        if d is not None:
+            out["duration_ms"] = round(d, 3)
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        return out
+
+    def __repr__(self) -> str:
+        state = "open" if self.open else f"{self.duration_ms:.3f}ms"
+        return f"<Span {self.span_id} {self.name!r} {state}>"
+
+
+class _NullSpan:
+    """Shared no-op span a disabled tracer hands out — every mutator is
+    a no-op, so call sites never branch on enablement."""
+
+    __slots__ = ()
+    trace_id = None
+    span_id = None
+    parent_id = None
+    name = None
+    t_start = 0.0
+    t_end = 0.0
+    attrs = None
+    open = False
+    duration_ms = None
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Trace:
+    __slots__ = ("spans", "dropped")
+
+    def __init__(self):
+        self.spans: list[Span] = []
+        self.dropped = 0
+
+    def add(self, span: Span, cap: int) -> None:
+        if len(self.spans) >= cap:
+            # ring behavior: drop the oldest span, but keep the trace's
+            # FIRST span (the root anchor) alive so the tree stays
+            # navigable under churn
+            i = 1 if len(self.spans) > 1 and \
+                self.spans[0].parent_id is None else 0
+            del self.spans[i]
+            self.dropped += 1
+        self.spans.append(span)
+
+
+class Tracer:
+    """Span journal keyed by trace id. One per ``JobScheduler`` (job
+    ids are process-unique, so traces never collide); independently
+    constructible for tests."""
+
+    def __init__(self, clock=None, *, enabled: bool = True,
+                 max_spans: int = 4096, max_traces: int = 512):
+        self.clock = clock or time.time
+        self.enabled = enabled
+        self.max_spans = int(max_spans)
+        self.max_traces = int(max_traces)
+        self._traces: "OrderedDict[str, _Trace]" = OrderedDict()
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # -- write side ----------------------------------------------------------
+
+    def start(self, trace_id: str, name: str, parent=None, **attrs):
+        """Open a span; ``parent`` is a Span (or span id, or None)."""
+        if not self.enabled:
+            return NULL_SPAN
+        now = self.clock()
+        parent_id = parent.span_id if isinstance(parent, (Span, _NullSpan)) \
+            else parent
+        with self._lock:
+            tr = self._traces.get(trace_id)
+            if tr is None:
+                while len(self._traces) >= self.max_traces:
+                    self._traces.popitem(last=False)
+                tr = _Trace()
+                self._traces[trace_id] = tr
+            s = Span(trace_id, next(self._ids), parent_id, name, now,
+                     dict(attrs) if attrs else None)
+            tr.add(s, self.max_spans)
+        return s
+
+    def end(self, span, t_end: Optional[float] = None, **attrs) -> None:
+        if not isinstance(span, Span) or span.t_end is not None:
+            return
+        span.set(**attrs)
+        span.t_end = self.clock() if t_end is None else t_end
+
+    def event(self, trace_id: str, name: str, parent=None,
+              t0: Optional[float] = None, t1: Optional[float] = None,
+              **attrs):
+        """Record a COMPLETED span with explicit host timestamps — the
+        retroactive form the per-round seams use (the wall time was
+        measured by the kernel's own boundary callbacks)."""
+        if not self.enabled:
+            return NULL_SPAN
+        now = self.clock()
+        s = self.start(trace_id, name, parent=parent, **attrs)
+        # (t0, t1) given → explicit window; t0 only → t0..now;
+        # neither → an instant event stamped now
+        s.t_start = now if t0 is None else t0
+        if t1 is not None:
+            s.t_end = t1
+        else:
+            s.t_end = now if t0 is not None else s.t_start
+        return s
+
+    @contextmanager
+    def span(self, trace_id: str, name: str, parent=None, **attrs):
+        s = self.start(trace_id, name, parent=parent, **attrs)
+        try:
+            yield s
+        finally:
+            self.end(s)
+
+    def discard(self, trace_id: str) -> None:
+        with self._lock:
+            self._traces.pop(trace_id, None)
+
+    # -- read side -----------------------------------------------------------
+
+    def spans(self, trace_id: str) -> Optional[list]:
+        """Journal snapshot (insertion order), or None for an unknown
+        trace."""
+        with self._lock:
+            tr = self._traces.get(trace_id)
+            return list(tr.spans) if tr is not None else None
+
+    def dropped(self, trace_id: str) -> int:
+        with self._lock:
+            tr = self._traces.get(trace_id)
+            return tr.dropped if tr is not None else 0
+
+    def tree(self, trace_id: str) -> Optional[dict]:
+        """JSON span tree: ``{"trace", "dropped_spans", "spans":
+        [nested]}``; spans whose parent was ring-dropped surface as
+        roots (the tree must stay renderable under churn)."""
+        spans = self.spans(trace_id)
+        if spans is None:
+            return None
+        nodes = {s.span_id: {**s.to_dict(), "children": []}
+                 for s in spans}
+        roots: list = []
+        for s in spans:
+            node = nodes[s.span_id]
+            parent = nodes.get(s.parent_id)
+            (parent["children"] if parent is not None else roots
+             ).append(node)
+        return {"trace": trace_id, "dropped_spans": self.dropped(trace_id),
+                "spans": roots}
+
+
+class TraceHandle:
+    """What execution layers hold: (tracer, trace id, current parents).
+    The scheduler attaches one per job (``job.trace``) when tracing is
+    enabled — batcher/recovery/kernel hooks test ``job.trace is None``
+    and skip entirely when it is, so a disabled tracer costs one
+    attribute read per seam."""
+
+    __slots__ = ("tracer", "trace_id", "root", "queue", "attempt")
+
+    def __init__(self, tracer: Tracer, trace_id: str, root: Span):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.root = root
+        self.queue: Optional[Span] = None    # submit → first start
+        self.attempt: Optional[Span] = None  # current attempt span
+
+    @property
+    def parent(self):
+        """Default parent for execution spans: the in-flight attempt,
+        else the root."""
+        return self.attempt if self.attempt is not None else self.root
+
+    def start(self, name: str, parent=None, **attrs):
+        return self.tracer.start(self.trace_id, name,
+                                 parent=self.parent if parent is None
+                                 else parent, **attrs)
+
+    def end(self, span, **attrs) -> None:
+        self.tracer.end(span, **attrs)
+
+    def event(self, name: str, parent=None, t0=None, t1=None, **attrs):
+        return self.tracer.event(self.trace_id, name,
+                                 parent=self.parent if parent is None
+                                 else parent, t0=t0, t1=t1, **attrs)
+
+
+def trace_summary(tracer: Optional[Tracer], trace_id: str
+                  ) -> Optional[dict]:
+    """The ``GET /jobs`` digest of a job's trace: where the time went
+    (queue / fuse / device) plus the round count — computed from the
+    journal, None when the trace doesn't exist (tracing disabled /
+    evicted)."""
+    if tracer is None:
+        return None
+    spans = tracer.spans(trace_id)
+    if not spans:
+        return None
+    out: dict = {"spans": len(spans)}
+    rounds = 0
+    device_ms = 0.0
+    have_device = False
+    for s in spans:
+        d = s.duration_ms
+        if s.name == "queue" and d is not None:
+            out["queue_ms"] = round(d, 3)
+        elif s.name == "fuse" and d is not None:
+            out["fuse_ms"] = round(d, 3)
+        elif s.name == "run" and d is not None:
+            device_ms += d
+            have_device = True
+        elif s.name == "round":
+            rounds += 1
+    if have_device:
+        out["device_ms"] = round(device_ms, 3)
+    out["rounds"] = rounds
+    return out

@@ -46,6 +46,14 @@ from titan_tpu.olap.live.overlay import MIN_CAP, DeltaOverlay
 from titan_tpu.utils.metrics import MetricManager
 
 
+#: the plane's ``serving.live.*`` counter family — ONE definition
+#: shared by stats() and the metric-name doc-drift guard
+#: (tests/test_docs_metrics.py)
+_LIVE_COUNTERS = ("deltas_applied", "edges_added", "edges_tombstoned",
+                  "compactions", "resyncs", "feed_batches",
+                  "backpressure")
+
+
 class LiveGraphPlane:
     """See module doc. One plane serves one snapshot parameter set
     (``labels`` + ``directed``; extracted edge_keys are unsupported —
@@ -66,6 +74,10 @@ class LiveGraphPlane:
         self.labels = tuple(labels) if labels is not None else None
         self.directed = bool(directed)
         self._metrics = metrics or MetricManager.instance()
+        # obs seam: the owning JobScheduler lends its tracer (like the
+        # ledger) so apply/compaction epochs land on the reserved
+        # "live" trace id; None = no tracing
+        self._tracer = None
         self._lock = threading.RLock()
         self._min_cap = int(min_cap)
         self._ledger = ledger
@@ -350,6 +362,11 @@ class LiveGraphPlane:
             len(payloads))
         self._metrics.histogram("serving.live.apply_ms").update(
             (time.time() - t0) * 1e3)
+        if self._tracer is not None:
+            self._tracer.event("live", "apply", t0=t0,
+                               payloads=len(payloads),
+                               edges_added=added, tombstoned=tombed,
+                               epoch=self.epoch, seq=self.overlay.seq)
 
     # -- epoch boundaries ----------------------------------------------------
 
@@ -373,6 +390,9 @@ class LiveGraphPlane:
         self._metrics.counter("serving.live.compactions").inc()
         self._metrics.histogram("serving.live.compact_ms").update(
             (time.time() - t0) * 1e3)
+        if self._tracer is not None:
+            self._tracer.event("live", "compact", t0=t0, why=why,
+                               epoch=self.epoch)
 
     def compact_if_dirty(self) -> bool:
         """Force-fold the overlay (dense/PageRank's documented
@@ -442,10 +462,7 @@ class LiveGraphPlane:
                 "overlay": self.overlay.stats(),
                 "counters": {
                     k: m.counter_value(f"serving.live.{k}")
-                    for k in ("deltas_applied", "edges_added",
-                              "edges_tombstoned", "compactions",
-                              "resyncs", "feed_batches",
-                              "backpressure")},
+                    for k in _LIVE_COUNTERS},
                 "apply_ms": m.histogram("serving.live.apply_ms")
                              .to_dict(),
                 "compact_ms": m.histogram("serving.live.compact_ms")

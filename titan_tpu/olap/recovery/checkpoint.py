@@ -57,12 +57,16 @@ class JobRecovery:
     def save(self, round_: int, arrays: dict, *, kind: str,
              meta: Optional[dict] = None,
              objects: Optional[dict] = None) -> str:
+        t0 = time.time()
         if self.faults is not None and self.faults.slow_write_s > 0:
             time.sleep(self.faults.slow_write_s)
         path = self.store.save(self.key, attempt=self.job.attempt,
                                round_=round_, kind=kind, arrays=arrays,
                                meta=meta, objects=objects)
         self.job.checkpoint_round = round_
+        h = getattr(self.job, "trace", None)
+        if h is not None:    # obs: commit latency in the job's timeline
+            h.event("checkpoint", t0=t0, round=round_)
         if self.faults is not None \
                 and self.faults.should_corrupt(round_, self.job.attempt):
             self.faults.corrupt(path)
@@ -85,6 +89,10 @@ class JobRecovery:
         ``round_``."""
         replayed = max(0, int(self.job.last_round) - int(round_))
         self.job.rounds_replayed += replayed
+        h = getattr(self.job, "trace", None)
+        if h is not None:
+            h.event("resume", from_round=int(round_),
+                    rounds_replayed=replayed)
         if self._metrics is not None:
             self._metrics.counter("serving.recovery.resumes").inc()
             if replayed:
@@ -96,6 +104,9 @@ class JobRecovery:
         every round the failed attempt ran is replayed."""
         replayed = max(0, int(self.job.last_round))
         self.job.rounds_replayed += replayed
+        h = getattr(self.job, "trace", None)
+        if h is not None:
+            h.event("restart_clean", rounds_replayed=replayed)
         if self._metrics is not None and replayed:
             self._metrics.counter(
                 "serving.recovery.rounds_replayed").inc(replayed)

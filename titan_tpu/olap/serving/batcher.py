@@ -123,6 +123,7 @@ class Batcher:
         raising out of a level boundary fails the WHOLE batch (that is
         what a real worker death does), and each member then retries
         under its own policy."""
+        t_fuse0 = time.time()
         fresh: list[Job] = []
         fresh_src: list[int] = []
         resumed: list[tuple[Job, int, object]] = []
@@ -150,6 +151,19 @@ class Batcher:
             else:
                 fresh.append(job)
                 fresh_src.append(src)
+        # fuse decision record (obs): K, shared-plan reuse, and why a
+        # member ran solo — the amortization evidence per trace
+        t_fuse1 = time.time()
+        for job in fresh:
+            if job.trace is not None:
+                job.trace.event("fuse", t0=t_fuse0, t1=t_fuse1,
+                                k=len(fresh), shared_plan=len(fresh) > 1)
+        for job, _src, ck in resumed:
+            if job.trace is not None:
+                job.trace.event("fuse", t0=t_fuse0, t1=t_fuse1, k=1,
+                                shared_plan=False,
+                                solo="resumed from checkpoint "
+                                     f"round {ck.round}")
         if fresh:
             self._bfs_group(fresh, fresh_src, snap, None, 0,
                             overlay=overlay)
@@ -169,11 +183,29 @@ class Batcher:
         started = time.time()
         dropped = [None] * K    # terminal state decided at a boundary
         n = snap.n if hasattr(snap, "n") else snap["n"]
+        # device-run spans (obs): one "run" per job covering the shared
+        # level loop; per-level "round" children carry the job's OWN
+        # frontier count — all host timestamps from the level callback
+        # the kernel already makes (no extra syncs)
+        runs = [job.trace.start("run", k=K, start_level=start_level,
+                                **({"overlay_edges": overlay.count,
+                                    "overlay_tombs": overlay.tomb_count}
+                                   if overlay is not None
+                                   and not overlay.empty else {}))
+                if job.trace is not None else None
+                for job in runnable]
+        # anchor AFTER the run spans open so the first round's window
+        # nests inside them (children must not start before parents)
+        prev_t = [time.time()]
 
         def on_level(level, nf):
             keep = np.ones(K, bool)
             now = time.time()
             for i, job in enumerate(runnable):
+                if job.trace is not None and dropped[i] is None:
+                    job.trace.event("round", parent=runs[i],
+                                    t0=prev_t[0], t1=now, level=level,
+                                    frontier=int(nf[i]))
                 if dropped[i] is not None:
                     keep[i] = False
                     continue
@@ -190,6 +222,7 @@ class Batcher:
                         now - started > job.spec.timeout_s:
                     dropped[i] = "timeout"
                     keep[i] = False
+            prev_t[0] = now
             return keep if not keep.all() else None
 
         token = _epoch_token(snap, overlay)
@@ -215,10 +248,15 @@ class Batcher:
                 checkpoint=checkpoint if wants_ckpt else None,
                 overlay=overlay)
         except Exception as e:
-            for job in runnable:
+            for i, job in enumerate(runnable):
+                if job.trace is not None:
+                    job.trace.end(runs[i], error=f"{type(e).__name__}")
                 job.fail(f"{type(e).__name__}: {e}")
             return
         inf = int(INF)
+        for i, job in enumerate(runnable):
+            if job.trace is not None:
+                job.trace.end(runs[i], levels=int(levels[i]))
         for i, job in enumerate(runnable):
             if completed[i]:
                 job.complete(_bfs_result(snap, dist[i], levels[i], inf,
@@ -248,8 +286,48 @@ class Batcher:
         started = time.time()
         interrupted = {}
 
+        if kind == "bfs":
+            # bfs delegates wholesale — run_bfs_batch owns its own
+            # resume bookkeeping (doing it here too would double-count
+            # serving.recovery.resumes / rounds_replayed)
+            self.run_bfs_batch([job], snap, overlay=overlay)
+            return
+
+        h = job.trace
+        run_span = None
+        if h is not None and kind != "callable":
+            run_span = h.start(
+                "run", kind=kind,
+                **({"overlay_edges": overlay.count,
+                    "overlay_tombs": overlay.tomb_count}
+                   if overlay is not None and not overlay.empty
+                   else {}))
+        # round-window anchor: at/after the run span's start so round
+        # children nest inside it
+        prev_t = [time.time()]
+        # per-round timeline (obs): pagerank/dense rounds are stamped
+        # from the host callbacks below; sssp/wcc rounds come from
+        # _frontier_run's existing mass-accounting trace instead — it
+        # already carries frontier size / listed chunk mass / plan cost
+        # per round at zero extra syncs (the stats readback happens
+        # regardless), so the span timeline gets the band/plan story
+        # for free
+        trace_rounds = None
+        _csr_trace_prev = None
+        if h is not None and kind in ("sssp", "wcc"):
+            from titan_tpu.models.bfs_hybrid import build_chunked_csr
+            _csr = build_chunked_csr(snap)
+            _csr_trace_prev = _csr.get("_trace_rounds")
+            trace_rounds = []
+            _csr["_trace_rounds"] = trace_rounds
+
         def on_round(rounds):
             job.last_round = rounds
+            if h is not None and trace_rounds is None:
+                now = time.time()
+                h.event("round", parent=run_span, t0=prev_t[0], t1=now,
+                        round=rounds)
+                prev_t[0] = now
             if rec is not None and rec.faults is not None:
                 rec.faults.check(rounds, job.attempt, snap)
             if job.cancel_requested:
@@ -260,13 +338,6 @@ class Batcher:
                 interrupted["why"] = "timeout"
                 return False
             return True
-
-        if kind == "bfs":
-            # bfs delegates wholesale — run_bfs_batch owns its own
-            # resume bookkeeping (doing it here too would double-count
-            # serving.recovery.resumes / rounds_replayed)
-            self.run_bfs_batch([job], snap, overlay=overlay)
-            return
         epoch = _epoch_token(snap, overlay)
         ck = None
         if rec is not None and job.attempt > 1 and kind != "callable":
@@ -378,6 +449,11 @@ class Batcher:
 
                     def ckpt(it, state):
                         job.last_round = it
+                        if h is not None:
+                            now = time.time()
+                            h.event("round", parent=run_span,
+                                    t0=prev_t[0], t1=now, round=it)
+                            prev_t[0] = now
                         if rec.faults is not None:
                             rec.faults.check(it, job.attempt, snap)
                         if wants_ckpt and rec.due(it):
@@ -407,3 +483,27 @@ class Batcher:
                     job.mark_cancelled()
             else:
                 job.fail(f"{type(e).__name__}: {e}")
+        finally:
+            if h is not None:
+                if trace_rounds is not None:
+                    # bridge _frontier_run's per-round tuples
+                    # (band, frontier, chunk_mass, t_plan_done, plan_s)
+                    # into the span timeline, then detach the hook from
+                    # the snapshot's cached CSR
+                    t_prev = run_span.t_start if run_span is not None \
+                        else started
+                    for i, (band, nf, m8, t, plan_s) in \
+                            enumerate(trace_rounds):
+                        extra = {"band": float(band)} \
+                            if 0.0 < float(band) < 1e30 else {}
+                        h.event("round", parent=run_span, t0=t_prev,
+                                t1=t, round=i, frontier=int(nf),
+                                chunk_mass=int(m8),
+                                plan_ms=round(plan_s * 1e3, 3), **extra)
+                        t_prev = t
+                    if _csr_trace_prev is None:
+                        _csr.pop("_trace_rounds", None)
+                    else:
+                        _csr["_trace_rounds"] = _csr_trace_prev
+                if run_span is not None:
+                    h.end(run_span, rounds=int(job.last_round))

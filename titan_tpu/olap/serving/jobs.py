@@ -88,6 +88,11 @@ class Job:
         self.rounds_replayed: int = 0
         self.checkpoint_round: Optional[int] = None
         self.recovery = None
+        # observability plane (titan_tpu/obs): the scheduler-attached
+        # TraceHandle when tracing is enabled; None otherwise —
+        # execution hooks test this ONE attribute, so tracing-off costs
+        # nothing per round
+        self.trace = None
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._cancel = threading.Event()

@@ -34,16 +34,25 @@ Metrics (utils/metrics.MetricManager):
   serving.recovery.invalid_checkpoints (digest-rejected at resume)
   serving.recovery.resumes / .rounds_replayed
   serving.recovery.retries / .retries_exhausted
+
+Tracing (titan_tpu/obs, ISSUE r10): one trace per job (trace id ==
+job id) — ``submit`` / ``queue`` / per-attempt ``attempt`` spans open
+here; ``fuse`` / ``run`` / per-round ``round`` / ``checkpoint`` spans
+in the batcher and recovery hooks; the terminal state stamps the root.
+``GET /trace?job=<id>`` renders the tree; ``tracing=False`` (or
+TITAN_TPU_TRACING=0) removes the whole plane.
 """
 
 from __future__ import annotations
 
 import heapq
 import itertools
+import os
 import threading
 import time
 from typing import Optional
 
+from titan_tpu.obs.tracing import TraceHandle, Tracer
 from titan_tpu.olap.api import JobSpec
 from titan_tpu.olap.serving.batcher import Batcher, batch_key
 from titan_tpu.olap.serving.hbm import (DEFAULT_BUDGET_BYTES,
@@ -68,7 +77,20 @@ class JobScheduler:
                  metrics: Optional[MetricManager] = None,
                  autostart: bool = True,
                  checkpoint_dir: Optional[str] = None,
-                 live=None):
+                 live=None, tracer: Optional[Tracer] = None,
+                 tracing: Optional[bool] = None):
+        # observability plane (titan_tpu/obs): one tracer per scheduler,
+        # one trace per job (trace id == job id) — submit/queue/attempt
+        # spans here, fuse/run/round/checkpoint spans in the batcher &
+        # recovery hooks, all host-side. ``tracing=False`` (or env
+        # TITAN_TPU_TRACING=0) removes it wholesale: jobs carry no
+        # TraceHandle and every hook is a single None check.
+        if tracer is None:
+            if tracing is None:
+                tracing = os.environ.get("TITAN_TPU_TRACING", "1") \
+                    .lower() not in ("0", "false", "off")
+            tracer = Tracer(enabled=tracing)
+        self.tracer = tracer
         # live plane (olap/live): jobs lease (snapshot, overlay) pairs
         # at a consistent epoch instead of refresh/rebuild churn; the
         # scheduler OWNS the plane's lifecycle once attached (close()
@@ -79,6 +101,10 @@ class JobScheduler:
         self.ledger = HBMLedger(hbm_budget_bytes, on_evict=self._evict)
         if live is not None and live._ledger is None:
             live._ledger = self.ledger
+        if live is not None and getattr(live, "_tracer", None) is None:
+            # the plane records apply/compaction epochs under the
+            # reserved "live" trace id (GET /trace?job=live)
+            live._tracer = self.tracer
         self.batcher = Batcher(max_batch=max_batch)
         self.max_batch = max_batch
         self._metrics = metrics or MetricManager.instance()
@@ -177,6 +203,11 @@ class JobScheduler:
                                  "recovery.FaultPlan (test harness "
                                  "only, not wire-settable)")
         job = Job(spec)
+        if self.tracer.enabled:
+            root = self.tracer.start(job.id, "job", kind=spec.kind,
+                                     priority=spec.priority)
+            job.trace = TraceHandle(self.tracer, job.id, root)
+            job.trace.event("submit", parent=root)
         store = self.ckpt_store \
             if self.ckpt_store is not None and spec.checkpoint_every > 0 \
             else None
@@ -197,9 +228,16 @@ class JobScheduler:
         with self._cv:
             if self._stop:
                 self._metrics.counter("serving.jobs.rejected").inc()
+                # the job was never admitted: drop its just-opened
+                # trace, or rejected submits would pile never-ending
+                # root spans into the tracer's LRU
+                self.tracer.discard(job.id)
                 raise RuntimeError("scheduler is closed")
             self._metrics.counter("serving.jobs.submitted").inc()
             self._jobs[job.id] = job
+            if job.trace is not None:
+                job.trace.queue = job.trace.start(
+                    "queue", parent=job.trace.root)
             self._push_locked(job)
         return job
 
@@ -240,6 +278,13 @@ class JobScheduler:
         (``GET /live``); None when no plane is attached."""
         return self.live.stats() if self.live is not None else None
 
+    def trace_summary(self, job_id: str) -> Optional[dict]:
+        """Per-job trace digest (queue_ms / fuse_ms / device_ms /
+        rounds) for the ``GET /jobs`` envelope; None when tracing is
+        disabled or the trace was evicted."""
+        from titan_tpu.obs.tracing import trace_summary
+        return trace_summary(self.tracer, job_id)
+
     def stats(self) -> dict:
         with self._cv:
             depth = sum(1 for *_x, j in self._heap
@@ -269,6 +314,19 @@ class JobScheduler:
         if not job.state.terminal or not job.metered_once():
             return
         name = self._STATE_COUNTER[job.state]
+        h = job.trace
+        if h is not None:
+            # close whatever is still open (a job cancelled while
+            # queued never started; an expired one never ran) and stamp
+            # the terminal state as the tree's last child
+            if h.attempt is not None:
+                h.end(h.attempt, state=job.state.value)
+                h.attempt = None
+            if h.queue is not None and h.queue.open:
+                h.end(h.queue)
+            h.event(job.state.value, parent=h.root)
+            h.end(h.root, status=job.state.value,
+                  **({"error": job.error} if job.error else {}))
         self._metrics.counter(f"serving.jobs.{name}").inc()
         if job.retries_exhausted:
             self._metrics.counter(
@@ -333,6 +391,13 @@ class JobScheduler:
                 self._finalize_metrics(job)
                 return
             self._metrics.counter("serving.recovery.retries").inc()
+            if job.trace is not None:
+                job.trace.event(
+                    "retrying", parent=job.trace.root,
+                    attempt=job.attempt,
+                    backoff_s=round(max(0.0, (job.not_before or 0)
+                                        - time.time()), 4),
+                    **({"error": job.error} if job.error else {}))
             self._push_locked(job)
 
     def _run(self) -> None:
@@ -365,6 +430,11 @@ class JobScheduler:
                     self._running_batch = 0
             for job in group:
                 if job.state is JobState.RETRYING:
+                    if job.trace is not None \
+                            and job.trace.attempt is not None:
+                        job.trace.end(job.trace.attempt,
+                                      state=JobState.RETRYING.value)
+                        job.trace.attempt = None
                     self._requeue(job)
                 else:
                     self._finalize_metrics(job)
@@ -380,6 +450,12 @@ class JobScheduler:
         for job in group:
             first_start = job.started_at is None
             job.start()
+            h = job.trace
+            if h is not None:
+                if first_start and h.queue is not None:
+                    h.end(h.queue)
+                h.attempt = h.start("attempt", parent=h.root,
+                                    attempt=job.attempt)
             q = job.queue_seconds()
             # retry attempts keep the FIRST start time: sample the
             # submit->start latency once per job, not once per attempt

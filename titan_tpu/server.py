@@ -40,6 +40,17 @@ Endpoints:
   DELETE /jobs/<id> — cancel (queued or retrying: immediate; running:
                       at the next level boundary via the per-job
                       early-exit mask)
+  GET  /metrics     — Prometheus text exposition of every registered
+                      counter/timer/histogram (titan_tpu/obs/promexport;
+                      content type ``text/plain; version=0.0.4``)
+  GET  /trace?job=<id> — the job's span tree as JSON (obs/tracing:
+                      submit→queue→fuse→per-round→checkpoint→retrying→
+                      resume→terminal; 404 for unknown traces; the
+                      reserved id ``live`` holds the live plane's
+                      apply/compaction timeline). Each ``GET /jobs``
+                      entry also carries a ``trace`` digest
+                      (queue_ms / fuse_ms / device_ms / rounds).
+                      docs/observability.md documents the span model.
 
 Server config is a YAML file (gremlin-server.yaml analog):
   host: 127.0.0.1
@@ -137,6 +148,29 @@ class GraphServer:
                 self._scheduler = JobScheduler(graph=self.graph)
             return self._scheduler
 
+    def metrics_manager(self):
+        """The registry ``GET /metrics`` scrapes: the scheduler's when
+        one is live (tests inject isolated managers through it), else
+        the graph's, else the process-wide singleton — WITHOUT lazily
+        constructing a scheduler just to serve a scrape."""
+        with self._sched_lock:
+            sched = self._scheduler
+        if sched is not None and not sched.closed:
+            return sched._metrics
+        if getattr(self.graph, "_metrics", None) is not None:
+            return self.graph._metrics
+        from titan_tpu.utils.metrics import MetricManager
+        return MetricManager.instance()
+
+    def tracer(self):
+        """The live scheduler's tracer, or None — WITHOUT lazily
+        constructing a scheduler (a /trace probe on an idle server must
+        not spin up a worker thread just to 404)."""
+        with self._sched_lock:
+            sched = self._scheduler
+        return sched.tracer if sched is not None and not sched.closed \
+            else None
+
     def submit_job(self, body: dict):
         """Wire body → JobSpec → scheduler (shared by POST /jobs and the
         smoke script). ``deadline_s`` is relative to now; params carry
@@ -217,6 +251,15 @@ class GraphServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_text(self, code: int, text: str,
+                           content_type: str) -> None:
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _authorized(self) -> bool:
                 if server.auth_token is None:
                     return True
@@ -249,14 +292,44 @@ class GraphServer:
                     g = server.graph
                     metrics = {}
                     if g._metrics is not None:
-                        metrics = {k: v for k, v in
+                        # counter values only, as before the unified
+                        # snapshot schema (full stats live on /metrics)
+                        metrics = {k: v["count"] for k, v in
                                    g._metrics.snapshot().items()
-                                   if isinstance(v, int)}
+                                   if v["type"] == "counter"}
                     self._send(200, {
                         "instance": g.instance_id,
                         "backend": g.backend.manager.name,
                         "computer": g.config.get(d.COMPUTER_BACKEND),
                         "metrics": metrics})
+                elif self.path == "/metrics":
+                    from titan_tpu.obs.promexport import (CONTENT_TYPE,
+                                                          render_prometheus)
+                    self._send_text(
+                        200, render_prometheus(server.metrics_manager()),
+                        CONTENT_TYPE)
+                elif self.path.split("?", 1)[0] == "/trace":
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    tid = (q.get("job") or [None])[0]
+                    if tid is None:
+                        self._send(400, {"error": "trace needs "
+                                                  "?job=<id>",
+                                         "type": "BadRequest",
+                                         "retryable": False})
+                        return
+                    tracer = server.tracer()
+                    tree = tracer.tree(tid) if tracer is not None \
+                        else None
+                    if tree is None:
+                        self._send(404, {"error": f"unknown trace "
+                                                  f"{tid!r} (tracing "
+                                                  f"disabled, evicted, "
+                                                  f"or never a job)",
+                                         "type": "NotFound",
+                                         "retryable": False})
+                    else:
+                        self._send(200, tree)
                 elif self.path == "/schema":
                     types = server.graph.schema.all_types()
                     self._send(200, {"types": [
@@ -264,9 +337,15 @@ class GraphServer:
                          "kind": type(t).__name__} for t in types]})
                 elif self.path == "/jobs":
                     sched = server.scheduler()
-                    self._send(200, {
-                        "stats": sched.stats(),
-                        "jobs": [j.to_wire() for j in sched.jobs()]})
+                    jobs = []
+                    for j in sched.jobs():
+                        w = j.to_wire()
+                        ts = sched.trace_summary(j.id)
+                        if ts is not None:
+                            w["trace"] = ts
+                        jobs.append(w)
+                    self._send(200, {"stats": sched.stats(),
+                                     "jobs": jobs})
                 elif self.path == "/live":
                     # live plane observability (olap/live): freshness
                     # lag, overlay fill, compaction/backpressure
@@ -277,14 +356,18 @@ class GraphServer:
                     else:
                         self._send(200, {"enabled": True, **live})
                 elif self.path.startswith("/jobs/"):
-                    job = server.scheduler().get(
-                        self.path[len("/jobs/"):])
+                    sched = server.scheduler()
+                    job = sched.get(self.path[len("/jobs/"):])
                     if job is None:
                         self._send(404, {"error": "unknown job",
                                          "type": "NotFound",
                                          "retryable": False})
                     else:
-                        self._send(200, job.to_wire())
+                        w = job.to_wire()
+                        ts = sched.trace_summary(job.id)
+                        if ts is not None:
+                            w["trace"] = ts
+                        self._send(200, w)
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 

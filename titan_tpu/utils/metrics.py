@@ -80,17 +80,25 @@ class Histogram:
     p50/p95 job-latency and batch-occupancy metric (the reference's
     Dropwizard histograms play this role; docs/monitoring.txt latency
     domains). Bounded reservoir (Vitter's algorithm R, deterministic
-    LCG so snapshots are reproducible): under ``max_samples`` updates
-    the percentiles are exact, beyond that a uniform sample."""
+    per-instance LCG — never the process-global RNG — so p50/p95
+    assertions are reproducible; ``seed`` is injectable for tests that
+    sweep reservoirs): under ``max_samples`` updates the percentiles
+    are exact, beyond that a uniform sample."""
 
-    def __init__(self, max_samples: int = 2048):
+    #: default LCG state — every Histogram built without a seed samples
+    #: identically given identical update sequences
+    DEFAULT_SEED = 0x2545F4914F6CDD1D
+
+    def __init__(self, max_samples: int = 2048,
+                 seed: Optional[int] = None):
         self._max = max_samples
         self._samples: list[float] = []
         self.count = 0
         self.total = 0.0
         self.min = 0.0
         self.max = 0.0
-        self._rng_state = 0x2545F4914F6CDD1D
+        self._rng_state = (self.DEFAULT_SEED if seed is None
+                           else int(seed) & (2**64 - 1)) or 1
         self._lock = threading.Lock()
 
     def _rand(self, bound: int) -> int:
@@ -129,8 +137,11 @@ class Histogram:
 
     def to_dict(self) -> dict:
         return {"count": self.count, "mean": self.mean, "min": self.min,
-                "max": self.max, "p50": self.percentile(50),
-                "p95": self.percentile(95)}
+                "max": self.max, "total": self.total,
+                "p50": self.percentile(50), "p95": self.percentile(95),
+                # how many reservoir samples back the percentiles —
+                # below max_samples they are exact, not estimates
+                "samples": len(self._samples)}
 
 
 class MetricManager:
@@ -168,11 +179,15 @@ class MetricManager:
                 t = self._timers.setdefault(name, Timer())
         return t
 
-    def histogram(self, name: str) -> Histogram:
+    def histogram(self, name: str, seed: Optional[int] = None
+                  ) -> Histogram:
+        """``seed`` applies only when this call CREATES the histogram
+        (reservoir sampling state is per-instance; see Histogram)."""
         h = self._histograms.get(name)
         if h is None:
             with self._lock:
-                h = self._histograms.setdefault(name, Histogram())
+                h = self._histograms.setdefault(name,
+                                                Histogram(seed=seed))
         return h
 
     def counter_value(self, name: str) -> int:
@@ -184,19 +199,23 @@ class MetricManager:
         return t.count if t is not None else 0
 
     def snapshot(self) -> dict:
-        """{name: value} for counters, {name: {count, mean_ms, ...}} for
-        timers — the reporter payload."""
+        """One UNIFIED schema across all three metric kinds (ISSUE r10:
+        the old shape was a bare int for counters, ad-hoc dicts for the
+        rest — every consumer type-sniffed): each entry is a dict with
+        ``type`` (counter | timer | histogram) and ``count``, plus the
+        kind's stats (timers in ms, histograms in their raw unit) —
+        the reporter/exporter payload."""
         out: dict = {}
         for name, c in sorted(self._counters.items()):
-            out[name] = c.count
+            out[name] = {"type": "counter", "count": c.count}
         for name, t in sorted(self._timers.items()):
-            out[name] = {"count": t.count,
+            out[name] = {"type": "timer", "count": t.count,
                          "mean_ms": t.mean_ns / 1e6,
                          "min_ms": t.min_ns / 1e6,
                          "max_ms": t.max_ns / 1e6,
                          "total_ms": t.total_ns / 1e6}
         for name, h in sorted(self._histograms.items()):
-            out[name] = h.to_dict()
+            out[name] = {"type": "histogram", **h.to_dict()}
         return out
 
     def reset(self) -> None:
@@ -211,33 +230,49 @@ class MetricManager:
     def report_console(self, out=None) -> str:
         buf = io.StringIO()
         for name, val in self.snapshot().items():
-            if isinstance(val, dict) and "mean_ms" in val:
+            kind = val["type"]
+            if kind == "timer":
                 buf.write(f"{name}: count={val['count']} "
                           f"mean={val['mean_ms']:.3f}ms max={val['max_ms']:.3f}ms\n")
-            elif isinstance(val, dict):     # histogram
+            elif kind == "histogram":
                 buf.write(f"{name}: count={val['count']} "
                           f"p50={val['p50']:.3f} p95={val['p95']:.3f} "
                           f"max={val['max']:.3f}\n")
             else:
-                buf.write(f"{name}: {val}\n")
+                buf.write(f"{name}: {val['count']}\n")
         text = buf.getvalue()
         if out is not None:
             out.write(text)
         return text
 
+    #: the ONE report_csv header, stable across all three metric kinds
+    #: (ISSUE r10: the old writer reused timer column names for
+    #: histogram raw-unit stats and left counters ragged)
+    CSV_HEADER = ("metric", "type", "count", "mean", "min", "max",
+                  "p50", "p95")
+
     def report_csv(self, path: str) -> None:
+        """One row per metric under ``CSV_HEADER``; timer stats are in
+        ms (as the snapshot reports them), histograms in their raw
+        unit, counter rows leave the stat columns empty."""
         with open(path, "w", newline="") as f:
             w = csv.writer(f)
-            w.writerow(["metric", "count", "mean_ms", "min_ms", "max_ms"])
+            w.writerow(self.CSV_HEADER)
             for name, val in self.snapshot().items():
-                if isinstance(val, dict) and "mean_ms" in val:
-                    w.writerow([name, val["count"], f"{val['mean_ms']:.6f}",
-                                f"{val['min_ms']:.6f}", f"{val['max_ms']:.6f}"])
-                elif isinstance(val, dict):     # histogram (raw units)
-                    w.writerow([name, val["count"], f"{val['mean']:.6f}",
-                                f"{val['min']:.6f}", f"{val['max']:.6f}"])
+                kind = val["type"]
+                if kind == "timer":
+                    w.writerow([name, kind, val["count"],
+                                f"{val['mean_ms']:.6f}",
+                                f"{val['min_ms']:.6f}",
+                                f"{val['max_ms']:.6f}", "", ""])
+                elif kind == "histogram":
+                    w.writerow([name, kind, val["count"],
+                                f"{val['mean']:.6f}", f"{val['min']:.6f}",
+                                f"{val['max']:.6f}", f"{val['p50']:.6f}",
+                                f"{val['p95']:.6f}"])
                 else:
-                    w.writerow([name, val, "", "", ""])
+                    w.writerow([name, kind, val["count"],
+                                "", "", "", "", ""])
 
 
 # live reporters keyed by (manager identity, sink identity): two graphs
@@ -286,6 +321,12 @@ class ScheduledReporter:
             self.report_now()
 
     def report_now(self) -> None:
+        # a report requested AFTER stop is a no-op: stop() may race an
+        # in-flight emit (which finishes and counts), but a post-stop
+        # call must not double-report to a sink the owner already
+        # considers closed (tests/test_metrics.py pins this race)
+        if self._stop.is_set():
+            return
         try:
             self.emit(self.manager, time.time())
             self.reports += 1
@@ -333,14 +374,15 @@ def _csv_emit(directory: str):
                 w.writerow(["timestamp", "metric", "count", "mean_ms",
                             "min_ms", "max_ms"])
             for name, val in manager.snapshot().items():
-                if isinstance(val, dict):
+                if val["type"] == "counter":
+                    w.writerow([f"{ts:.3f}", name, val["count"],
+                                "", "", ""])
+                else:
                     mean = val.get("mean_ms", val.get("mean", 0.0))
                     lo = val.get("min_ms", val.get("min", 0.0))
                     hi = val.get("max_ms", val.get("max", 0.0))
                     w.writerow([f"{ts:.3f}", name, val["count"],
                                 f"{mean:.6f}", f"{lo:.6f}", f"{hi:.6f}"])
-                else:
-                    w.writerow([f"{ts:.3f}", name, val, "", "", ""])
     return emit
 
 
@@ -352,16 +394,16 @@ def _graphite_emit(host: str, port: int, prefix: str):
         t = int(ts)
         for name, val in manager.snapshot().items():
             key = f"{prefix}.{name}".replace(" ", "_")
-            if isinstance(val, dict) and "mean_ms" in val:
+            if val["type"] == "timer":
                 lines.append(f"{key}.count {val['count']} {t}\n")
                 lines.append(f"{key}.mean_ms {val['mean_ms']:.6f} {t}\n")
                 lines.append(f"{key}.max_ms {val['max_ms']:.6f} {t}\n")
-            elif isinstance(val, dict):     # histogram
+            elif val["type"] == "histogram":
                 lines.append(f"{key}.count {val['count']} {t}\n")
                 lines.append(f"{key}.p50 {val['p50']:.6f} {t}\n")
                 lines.append(f"{key}.p95 {val['p95']:.6f} {t}\n")
             else:
-                lines.append(f"{key} {val} {t}\n")
+                lines.append(f"{key} {val['count']} {t}\n")
         with socket.create_connection((host, port), timeout=5.0) as s:
             s.sendall("".join(lines).encode())
     return emit
