@@ -35,6 +35,7 @@ _DOC_ROW = re.compile(r"^\|\s*`(serving\.[a-z0-9_.]+)`\s*\|",
 
 
 def _code_metric_names() -> set:
+    from titan_tpu.obs.slo import _BAD_STATES, _GOOD_STATES
     from titan_tpu.olap.live.plane import _LIVE_COUNTERS
     from titan_tpu.olap.serving.scheduler import JobScheduler
 
@@ -44,6 +45,9 @@ def _code_metric_names() -> set:
             for v in JobScheduler._STATE_COUNTER.values()],
         "serving.live.{k}": [f"serving.live.{k}"
                              for k in _LIVE_COUNTERS],
+        # the SLO engine READS these state counters (obs/slo SLI)
+        "serving.jobs.{s}": [f"serving.jobs.{s}"
+                             for s in _GOOD_STATES + _BAD_STATES],
     }
     names: set = set()
     for dirpath, dirnames, filenames in os.walk(_PKG):
@@ -77,9 +81,11 @@ def _doc_metric_names() -> set:
 def test_every_code_metric_documented_and_vice_versa():
     code = _code_metric_names()
     docs = _doc_metric_names()
-    # sanity: the scan actually found all three families
+    # sanity: the scan actually found every family (ISSUE 8 extended
+    # the guard to the tenant/SLO/gauge names)
     for family in ("serving.jobs.", "serving.live.",
-                   "serving.recovery."):
+                   "serving.recovery.", "serving.tenant.",
+                   "serving.slo.", "serving.hbm.", "serving.pool."):
         assert any(n.startswith(family) for n in code), (family, code)
     missing_from_docs = code - docs
     assert not missing_from_docs, (

@@ -404,3 +404,162 @@ def test_start_reporters_dedups_per_manager_and_sink():
         assert r4[0] not in _ACTIVE_REPORTERS.values()
     finally:
         r3[0].stop()
+
+
+# -- dimensional children + gauges (ISSUE 8) ------------------------------
+
+
+def test_labeled_children_roll_up_into_parent():
+    """Every update through a labeled child lands on the unlabeled
+    parent too — the roll-up contract all pre-label consumers rely on."""
+    m = MetricManager()
+    m.counter("serving.jobs.completed",
+              labels={"tenant": "a", "kind": "bfs"}).inc(3)
+    m.counter("serving.jobs.completed",
+              labels={"tenant": "b", "kind": "bfs"}).inc(2)
+    m.counter("serving.jobs.completed").inc()      # direct parent move
+    assert m.counter_value("serving.jobs.completed") == 6
+    # children() filters by label subset; counter_value(labels=) sums
+    assert m.counter_value("serving.jobs.completed",
+                           labels={"tenant": "a"}) == 3
+    assert m.counter_value("serving.jobs.completed",
+                           labels={"kind": "bfs"}) == 5
+    t = m.timer("op.time", labels={"tenant": "a"})
+    t.update(2_000_000)
+    assert m.timer_count("op.time") == 1
+    assert t.count == 1
+    h = m.histogram("serving.job.latency_ms", labels={"tenant": "a"})
+    for v in (1.0, 5.0, 9.0):
+        h.update(v)
+    parent = m.histogram("serving.job.latency_ms")
+    assert parent.count == 3 and h.count == 3
+    assert sorted(parent.values()) == [1.0, 5.0, 9.0]
+    assert sorted(h.values()) == [1.0, 5.0, 9.0]
+
+
+def test_label_set_canonical_regardless_of_order():
+    m = MetricManager()
+    a = m.counter("c.x.y", labels={"k1": "v", "k2": "w"})
+    b = m.counter("c.x.y", labels={"k2": "w", "k1": "v"})
+    a.inc()
+    b.inc()
+    assert a.child is b.child        # one child, not two
+    assert m.counter_value("c.x.y") == 2
+    assert len(m.children("c.x.y")) == 1
+
+
+def test_labeled_sum_exact_under_concurrent_multitenant_updates():
+    """The per-tenant children of a name sum EXACTLY to the unlabeled
+    aggregate under concurrent updates from many threads (the ISSUE 8
+    property the whole attribution plane hangs off)."""
+    import threading
+
+    m = MetricManager()
+    tenants = ["a", "b", "c", "d"]
+    per_thread = 200
+
+    def worker(seed):
+        for i in range(per_thread):
+            m.counter("serving.jobs.submitted",
+                      labels={"tenant": tenants[(seed + i) % 4]}).inc()
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = 8 * per_thread
+    assert m.counter_value("serving.jobs.submitted") == total
+    by_child = sum(c.count for _l, c in
+                   m.children("serving.jobs.submitted"))
+    assert by_child == total
+    assert len(m.children("serving.jobs.submitted")) == 4
+
+
+def test_max_children_cardinality_guard_degrades_to_parent():
+    """Past MAX_CHILDREN a NEW label set degrades to the unlabeled
+    parent (abusive wire-supplied tenant ids must not grow the registry
+    without bound); existing children keep working."""
+    m = MetricManager()
+    m.MAX_CHILDREN = 2
+    a = m.counter("c.a.b", labels={"t": "1"})
+    b = m.counter("c.a.b", labels={"t": "2"})
+    over = m.counter("c.a.b", labels={"t": "3"})
+    parent = m.counter("c.a.b")
+    assert over is parent            # degraded, not a third child
+    a.inc()
+    b.inc()
+    over.inc()
+    assert m.counter_value("c.a.b") == 3
+    assert len(m.children("c.a.b")) == 2
+    # the existing children still write through
+    assert m.counter("c.a.b", labels={"t": "1"}) is a
+    # the degrade is NEVER silent: every degraded lookup counts (the
+    # family's children no longer sum to the parent and per-label
+    # readers are blind to the dropped set — alertable signal)
+    assert m.counter_value(MetricManager.LABELS_DROPPED) == 1
+    m.counter("c.a.b", labels={"t": "4"}).inc()
+    assert m.counter_value(MetricManager.LABELS_DROPPED) == 2
+    assert "metrics.labels.dropped" in m.snapshot()
+    # ...but a run that never overflows carries no trace of it
+    assert MetricManager.LABELS_DROPPED not in MetricManager().snapshot()
+
+
+def test_gauge_callback_set_value_and_parent_sum():
+    m = MetricManager()
+    # callback-backed: read at scrape time
+    state = {"v": 7}
+    m.gauge("pool.size.current", fn=lambda: state["v"])
+    assert m.gauge_value("pool.size.current") == 7.0
+    state["v"] = 9
+    assert m.gauge_value("pool.size.current") == 9.0
+    # set()-backed without callback
+    g = m.gauge("plain.gauge.value")
+    g.set(3.5)
+    assert m.gauge_value("plain.gauge.value") == 3.5
+    # a broken callback reads 0.0, never raises into the scrape
+    m.gauge("dead.gauge.value", fn=lambda: 1 / 0)
+    assert m.gauge_value("dead.gauge.value") == 0.0
+    # a parent with no callback of its own sums its labeled children
+    m.gauge("slo.burn.rate", fn=lambda: 1.25,
+            labels={"slo": "x", "window": "300s"})
+    m.gauge("slo.burn.rate", fn=lambda: 0.25,
+            labels={"slo": "x", "window": "3600s"})
+    assert m.gauge_value("slo.burn.rate") == 1.5
+    assert m.gauge_value("slo.burn.rate",
+                         labels={"slo": "x", "window": "300s"}) == 1.25
+    snap = m.gauge_snapshot()
+    assert snap["slo.burn.rate"]["value"] == 1.5
+    assert len(snap["slo.burn.rate"]["children"]) == 2
+    # latest registration re-binds the callback (owner turnover)
+    m.gauge("pool.size.current", fn=lambda: 42)
+    assert m.gauge_value("pool.size.current") == 42.0
+
+
+def test_snapshot_csv_and_counter_value_unchanged_by_labels(tmp_path):
+    """Regression (ISSUE 8 acceptance): labels are invisible to every
+    pre-label consumer — ``snapshot()`` schema, the CSV header/rows and
+    plain ``counter_value`` are byte-identical whether the updates came
+    through labeled children or straight parents."""
+    via_labels = MetricManager()
+    via_labels.counter("serving.jobs.completed",
+                       labels={"tenant": "a"}).inc(2)
+    via_labels.counter("serving.jobs.completed",
+                       labels={"tenant": "b"}).inc(1)
+    via_labels.histogram("serving.job.latency_ms",
+                         labels={"tenant": "a"}).update(5.0)
+    via_labels.timer("op.x.time", labels={"tenant": "a"}).update(10**6)
+    via_labels.gauge("hbm.resident.bytes", fn=lambda: 1)  # not in snapshot
+    plain = MetricManager()
+    plain.counter("serving.jobs.completed").inc(3)
+    plain.histogram("serving.job.latency_ms").update(5.0)
+    plain.timer("op.x.time").update(10**6)
+    assert via_labels.snapshot() == plain.snapshot()
+    pa, pb = tmp_path / "a.csv", tmp_path / "b.csv"
+    via_labels.report_csv(str(pa))
+    plain.report_csv(str(pb))
+    assert pa.read_text() == pb.read_text()
+    assert MetricManager.CSV_HEADER == ("metric", "type", "count",
+                                        "mean", "min", "max",
+                                        "p50", "p95")

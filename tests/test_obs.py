@@ -172,8 +172,10 @@ def test_tracer_thread_safe_under_concurrent_writes():
 # ---------------------------------------------------------------------------
 
 # sample line grammar: name{labels} value  (exposition format 0.0.4)
+_LABEL_PAIR = r"[a-zA-Z0-9_]+=\"([^\"\\]|\\.)*\""
 _SAMPLE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\"\})? "
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{" + _LABEL_PAIR + r"(," + _LABEL_PAIR + r")*\})? "
     r"[+-]?(\d+\.?\d*([eE][+-]?\d+)?|inf|nan)$")
 
 
@@ -218,12 +220,17 @@ def test_render_prometheus_empty_registry():
 def test_queue_depth_renders_as_gauge_not_counter():
     """serving.queue.depth is inc/dec bookkeeping — exporting it as a
     Prometheus counter would make rate()/increase() read every dequeue
-    as a counter reset."""
+    as a counter reset. The flag lives on the metric itself
+    (``counter(name, gauge=True)``, set by the scheduler at startup —
+    ISSUE 8 replaced promexport's name allowlist), and it is sticky:
+    later unflagged get-or-create calls keep the gauge typing."""
     m = MetricManager()
-    m.counter("serving.queue.depth").inc(3)
+    m.counter("serving.queue.depth", gauge=True).inc(3)
+    m.counter("serving.queue.depth").inc(-1)     # sticky after this
     m.counter("serving.jobs.submitted").inc(3)
     text = render_prometheus(m)
     assert "# TYPE serving_queue_depth gauge" in text
+    assert "serving_queue_depth 2" in text
     assert "# TYPE serving_jobs_submitted counter" in text
 
 
@@ -232,3 +239,86 @@ def test_sanitize_names():
     assert sanitize("a b-c/d") == "a_b_c_d"
     assert sanitize("0zero") == "_0zero"
     assert re.match(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$", sanitize("9!@#"))
+
+
+def test_help_lines_from_description_registry():
+    """ISSUE 8 satellite: ``# HELP`` text comes from promexport's
+    per-name HELP registry and precedes the matching ``# TYPE``;
+    undescribed names get TYPE but no HELP; the whole body still
+    parses under the exposition grammar."""
+    from titan_tpu.obs.promexport import HELP
+    m = MetricManager()
+    m.counter("serving.jobs.submitted").inc(1)
+    m.counter("made.up.name").inc(1)
+    m.histogram("serving.job.latency_ms").update(2.0)
+    text = render_prometheus(m)
+    _assert_valid_exposition(text)
+    lines = text.splitlines()
+    i_help = lines.index("# HELP serving_jobs_submitted "
+                         + HELP["serving.jobs.submitted"])
+    assert lines[i_help + 1] == "# TYPE serving_jobs_submitted counter"
+    assert "# HELP serving_job_latency_ms " + \
+        HELP["serving.job.latency_ms"] in lines
+    assert "# TYPE made_up_name counter" in lines
+    assert not any(ln.startswith("# HELP made_up_name") for ln in lines)
+    # every HELP entry names a real metric family the registry can
+    # create — entries must not rot as names churn (the doc-drift
+    # guard covers the docs side; this pins the exposition side)
+    for name, text_ in HELP.items():
+        assert text_ and "\n" not in text_, name
+
+
+def test_labeled_children_render_and_sum_to_parent():
+    """Labeled children render as extra samples of the SAME family; the
+    unlabeled parent sample equals their sum, and the parent lines are
+    byte-identical to a registry that never used labels (ISSUE 8
+    regression criterion for the no-tenant path)."""
+    m = MetricManager()
+    m.counter("serving.jobs.completed",
+              labels={"tenant": "a", "kind": "bfs"}).inc(3)
+    m.counter("serving.jobs.completed",
+              labels={"tenant": "b", "kind": "bfs"}).inc(2)
+    h = m.histogram("serving.job.latency_ms", labels={"tenant": "a"})
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.update(v)
+    text = render_prometheus(m)
+    samples = _assert_valid_exposition(text)
+    assert "serving_jobs_completed 5" in samples
+    assert ('serving_jobs_completed{kind="bfs",tenant="a"} 3'
+            in samples)
+    assert ('serving_jobs_completed{kind="bfs",tenant="b"} 2'
+            in samples)
+    assert 'serving_job_latency_ms{quantile="0.5"} 3' in samples
+    # the summary's quantile pair lands LAST, after the child's own
+    # sorted labels (promexport._labels extra convention)
+    assert ('serving_job_latency_ms{tenant="a",quantile="0.95"} 4'
+            in samples)
+    assert 'serving_job_latency_ms_count{tenant="a"} 4' in samples
+    # parent sample lines byte-identical to a never-labeled registry
+    plain = MetricManager()
+    plain.counter("serving.jobs.completed").inc(5)
+    ph = plain.histogram("serving.job.latency_ms")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        ph.update(v)
+    plain_samples = _assert_valid_exposition(render_prometheus(plain))
+    assert set(plain_samples) <= set(samples)
+
+
+def test_gauges_render_with_children_and_escaping():
+    m = MetricManager()
+    m.gauge("serving.hbm.resident_bytes", fn=lambda: 1024)
+    m.gauge("serving.slo.burn_rate", fn=lambda: 2.5,
+            labels={"slo": 'we"ird\\na', "window": "300s"})
+    text = render_prometheus(m)
+    _assert_valid_exposition(text)
+    assert "# TYPE serving_hbm_resident_bytes gauge" in text
+    assert "serving_hbm_resident_bytes 1024" in text
+    assert "# TYPE serving_slo_burn_rate gauge" in text
+    # a children-only family (parent has no callback of its own) emits
+    # NO unlabeled sample: the sum roll-up is meaningless for ratio
+    # gauges like burn rates, so only the labeled children render
+    assert "\nserving_slo_burn_rate 2.5" not in text
+    assert ('serving_slo_burn_rate{slo="we\\"ird\\\\na",'
+            'window="300s"} 2.5' in text)
+    # programmatic roll-up read still available (additive families)
+    assert m.gauge_value("serving.slo.burn_rate") == 2.5

@@ -95,8 +95,10 @@ def _walk(tree_node, acc):
 # ---------------------------------------------------------------------------
 
 
+_LABEL_PAIR = r"[a-zA-Z0-9_]+=\"([^\"\\]|\\.)*\""
 _SAMPLE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\"\})? "
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{" + _LABEL_PAIR + r"(," + _LABEL_PAIR + r")*\})? "
     r"[+-]?(\d+\.?\d*([eE][+-]?\d+)?)$")
 
 

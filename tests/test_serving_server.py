@@ -180,3 +180,61 @@ def test_eight_concurrent_jobs_fuse_and_return_distinct_results(served):
                     for f in finals.values()]
     assert len(set(target_dists)) > 1
     assert metrics.histogram("serving.batch.occupancy").max == 8
+
+
+def test_tenant_wire_quota_429_and_tenant_slo_endpoints(served):
+    """ISSUE 8 wire surface: ``tenant`` rides the POST /jobs body into
+    the envelope; a quota-refused submit is 429 + retryable (never a
+    400 caller error); GET /tenants returns the attribution rows +
+    quotas; GET /slo reports burn rates (and {"enabled": false}
+    without objectives)."""
+    from titan_tpu.obs.slo import SLO
+    from titan_tpu.olap.serving.tenants import TenantQuota
+
+    g, srv = served
+    # default scheduler first: /slo and /tenants answer without setup
+    code, body = _req(srv, "/slo")
+    assert code == 200 and body == {"enabled": False}
+    code, body = _req(srv, "/tenants")
+    assert code == 200 and body["enforce_quotas"] is False
+
+    sched = JobScheduler(
+        graph=g, autostart=False, enforce_quotas=True,
+        quotas={"flood": TenantQuota(max_in_flight=1)},
+        slos=[SLO("flood-avail", tenant="flood",
+                  success_rate=0.999)])
+    srv._scheduler = sched
+    code, body = _req(srv, "/traversal",
+                      {"gremlin": "g.V().has('name','hercules')"
+                                  ".next().id"}, method="POST")
+    vid = body["result"]
+    code, j1 = _req(srv, "/jobs",
+                    {"kind": "bfs", "source": vid,
+                     "tenant": "flood"}, method="POST")
+    assert code == 202 and j1["tenant"] == "flood"
+    # paused worker keeps j1 in flight → the second submit violates
+    code, err = _req(srv, "/jobs",
+                     {"kind": "bfs", "source": vid,
+                      "tenant": "flood"}, method="POST")
+    assert code == 429, err
+    assert err["type"] == "QuotaExceeded" and err["retryable"] is True
+    # other tenants unaffected; absent tenant falls back to default
+    code, j2 = _req(srv, "/jobs", {"kind": "bfs", "source": vid},
+                    method="POST")
+    assert code == 202 and j2["tenant"] == "default"
+    sched.start()
+    assert _poll(srv, j1["job"])["status"] == "done"
+    assert _poll(srv, j2["job"])["status"] == "done"
+    code, body = _req(srv, "/tenants")
+    assert code == 200 and body["enforce_quotas"] is True
+    rows = body["tenants"]
+    assert rows["flood"]["rejected"] == 1
+    assert rows["flood"]["by_state"] == {"completed": 1}
+    assert rows["default"]["device_seconds"] > 0
+    assert body["quotas"]["flood"]["max_in_flight"] == 1
+    code, body = _req(srv, "/slo")
+    assert code == 200 and body["enabled"] is True
+    (s,) = body["slos"]
+    assert s["slo"] == "flood-avail" and s["tenant"] == "flood"
+    assert s["sli"]["ok"] is True
+    assert s["windows"]["300s"]["burn_rate"] == 0.0

@@ -1,0 +1,529 @@
+"""Per-tenant SLO plane (ISSUE 8): attribution, quotas, fallbacks.
+
+Covers the tenancy dimension end to end: labeled per-tenant counters
+summing EXACTLY to the unlabeled aggregate under concurrent
+multi-tenant submits; absent/unknown tenant falling back to
+``"default"`` everywhere (wire envelopes, traces, metrics) rather than
+a KeyError; quota admission in shadow vs enforce mode (429 over HTTP);
+device-seconds / HBM-byte-seconds attribution across a mixed-tenant
+fused batch; and the no-tenant regression criterion (metric names,
+snapshot schema, exposition parents unchanged).
+
+Host-heavy by design: most paths use ``callable`` jobs (no device
+kernels); the fused-batch attribution test reuses the n=192/m=900/
+seed-42 shape + K=8 shared with tests/test_serving.py so the XLA
+compile buckets stay warm.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from titan_tpu.olap.api import JobSpec
+from titan_tpu.olap.serving.scheduler import JobScheduler
+from titan_tpu.olap.serving.tenants import (DEFAULT_TENANT,
+                                            QuotaExceeded,
+                                            TenantAccounting,
+                                            TenantQuota,
+                                            effective_tenant)
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.utils.metrics import MetricManager
+
+_N = 192      # ONE shape across serving suites (compile buckets)
+
+
+def _sym_snapshot(seed: int = 42, n: int = _N, m: int = 900):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    return snap_mod.from_arrays(n, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+
+
+@pytest.fixture(scope="module")
+def snap_main():
+    return _sym_snapshot()
+
+
+def _callable_spec(tenant=None, value=1, **kw):
+    return JobSpec(kind="callable", params={"fn": lambda: value},
+                   tenant=tenant, **kw)
+
+
+# --------------------------------------------------------------------------
+# tenant identity + fallback
+# --------------------------------------------------------------------------
+
+def test_effective_tenant_fallback_and_stringification():
+    assert effective_tenant(None) == DEFAULT_TENANT == "default"
+    assert effective_tenant("") == "default"
+    assert effective_tenant("team-a") == "team-a"
+    assert effective_tenant(7) == "7"          # wire may send numbers
+
+
+def test_absent_tenant_is_default_everywhere(snap_main):
+    """No ``tenant`` on the spec → "default" in the wire envelope, the
+    trace root attrs, the metric children and the accounting rows —
+    never a KeyError anywhere."""
+    m = MetricManager()
+    sched = JobScheduler(snapshot=snap_main, metrics=m)
+    try:
+        job = sched.submit(_callable_spec())
+        assert job.wait(30) and job.state.value == "done"
+        assert job.tenant == "default"
+        assert job.to_wire()["tenant"] == "default"
+        # trace root carries the tenant attr
+        tree = sched.tracer.tree(job.id)
+        assert tree["spans"][0]["attrs"]["tenant"] == "default"
+        # metrics children labeled with the default tenant
+        assert m.counter_value("serving.jobs.completed",
+                               labels={"tenant": "default"}) == 1
+        # accounting row exists under "default"
+        rows = sched.tenant_stats()["tenants"]
+        assert rows["default"]["submitted"] == 1
+        assert rows["default"]["by_state"] == {"completed": 1}
+        # an unknown tenant string is just a new row, never an error
+        j2 = sched.submit(_callable_spec(tenant="never-seen"))
+        assert j2.wait(30)
+        assert sched.tenant_stats()["tenants"]["never-seen"][
+            "submitted"] == 1
+    finally:
+        sched.close()
+
+
+# --------------------------------------------------------------------------
+# the roll-up property under concurrency
+# --------------------------------------------------------------------------
+
+def test_labeled_counters_sum_to_aggregate_under_concurrent_submits(
+        snap_main):
+    """ISSUE 8 property: after a concurrent multi-tenant burst, the
+    per-tenant children of every job counter sum EXACTLY to the
+    unlabeled aggregate, and per-tenant counts match what each thread
+    actually submitted."""
+    m = MetricManager()
+    sched = JobScheduler(snapshot=snap_main, metrics=m)
+    tenants = ["alpha", "beta", "gamma", None]
+    per_thread = 12
+    jobs: list = []
+    jobs_lock = threading.Lock()
+
+    def submitter(k):
+        mine = []
+        for i in range(per_thread):
+            mine.append(sched.submit(_callable_spec(
+                tenant=tenants[(k + i) % len(tenants)])))
+        with jobs_lock:
+            jobs.extend(mine)
+
+    try:
+        threads = [threading.Thread(target=submitter, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        total = 4 * per_thread
+        assert len(jobs) == total
+        for j in jobs:
+            assert j.wait(60), j
+        # worker finalizes counters just after wait() fires — poll
+        deadline = time.time() + 10
+        while time.time() < deadline and m.counter_value(
+                "serving.jobs.completed") < total:
+            time.sleep(0.01)
+        for name in ("serving.jobs.submitted",
+                     "serving.jobs.completed"):
+            assert m.counter_value(name) == total
+            kids = m.children(name)
+            assert sum(c.count for _l, c in kids) == total, name
+            # every tenant (incl. the default fallback) present
+            seen = {lbl["tenant"] for lbl, _c in kids}
+            assert seen == {"alpha", "beta", "gamma", "default"}
+        # 4 threads x 12 jobs round-robined over 4 tenants = 12 each
+        assert m.counter_value("serving.jobs.completed",
+                               labels={"tenant": "alpha"}) == 12
+        # latency histogram children roll up exactly too
+        lat = m.histogram("serving.job.latency_ms")
+        assert lat.count == total
+        assert sum(h.count for _l, h in
+                   m.children("serving.job.latency_ms")) == total
+    finally:
+        sched.close()
+
+
+# --------------------------------------------------------------------------
+# quota admission: shadow mode vs enforcement
+# --------------------------------------------------------------------------
+
+def test_quota_shadow_mode_admits_but_counts_throttled(snap_main):
+    m = MetricManager()
+    sched = JobScheduler(
+        snapshot=snap_main, metrics=m, autostart=False,
+        quotas={"flood": TenantQuota(max_in_flight=1)})
+    try:
+        j1 = sched.submit(_callable_spec(tenant="flood"))
+        j2 = sched.submit(_callable_spec(tenant="flood"))  # violating
+        assert j2.state.value == "queued"     # admitted (shadow mode)
+        assert m.counter_value("serving.tenant.throttled") == 1
+        assert m.counter_value("serving.tenant.throttled",
+                               labels={"tenant": "flood"}) == 1
+        assert m.counter_value("serving.tenant.rejected") == 0
+        assert m.counter_value("serving.jobs.submitted") == 2
+        sched.start()
+        assert j1.wait(30) and j2.wait(30)
+    finally:
+        sched.close()
+
+
+def test_quota_enforcement_rejects_flooder_only(snap_main):
+    """With enforcement on, the violating tenant's submit raises
+    QuotaExceeded and counts serving.tenant.rejected — while other
+    tenants (and the flooder below its limit) stay admitted; rejected
+    submits never count as submitted."""
+    m = MetricManager()
+    sched = JobScheduler(
+        snapshot=snap_main, metrics=m, autostart=False,
+        enforce_quotas=True,
+        quotas={"flood": TenantQuota(max_in_flight=2)})
+    try:
+        a = sched.submit(_callable_spec(tenant="flood"))
+        b = sched.submit(_callable_spec(tenant="flood"))
+        with pytest.raises(QuotaExceeded, match="in-flight"):
+            sched.submit(_callable_spec(tenant="flood"))
+        quiet = sched.submit(_callable_spec(tenant="quiet"))
+        assert m.counter_value("serving.tenant.rejected",
+                               labels={"tenant": "flood"}) == 1
+        assert m.counter_value("serving.tenant.rejected",
+                               labels={"tenant": "quiet"}) == 0
+        assert m.counter_value("serving.jobs.submitted") == 3
+        rows = sched.tenant_stats()
+        assert rows["enforce_quotas"] is True
+        assert rows["tenants"]["flood"]["rejected"] == 1
+        assert rows["quotas"]["flood"]["max_in_flight"] == 2
+        sched.start()
+        for j in (a, b, quiet):
+            assert j.wait(30)
+        # in-flight drained: the next flood submit is admitted again
+        c = sched.submit(_callable_spec(tenant="flood"))
+        assert c.wait(30)
+    finally:
+        sched.close()
+
+
+def test_device_seconds_budget_quota():
+    """max_device_seconds is a cumulative budget: once the tenant has
+    burned it, further submits are refused (enforcement on)."""
+    acc = TenantAccounting()
+    q = TenantQuota(max_device_seconds=1.0)
+    assert acc.violation("t", q) is None
+    acc.device_seconds("t", 1.5)
+    why = acc.violation("t", q)
+    assert why is not None and "device-seconds" in why
+    # hbm limit checks bytes held by RUNNING jobs
+    q2 = TenantQuota(max_hbm_bytes=100.0)
+    acc.hold_hbm("t", 150.0)
+    assert "HBM" in acc.violation("t", q2)
+    acc.drop_hbm("t", 150.0)
+    assert acc.violation("t", q2) is None
+
+
+# --------------------------------------------------------------------------
+# resource attribution across a mixed-tenant fused batch
+# --------------------------------------------------------------------------
+
+def test_fused_batch_attribution_splits_across_tenants(snap_main):
+    """A K=8 fused BFS batch with 6 alpha + 2 beta jobs: batch wall
+    time and the graph image's ledger bytes x wall split EVENLY across
+    the K members, so alpha gets exactly 3x beta's device-seconds and
+    HBM byte-seconds; per-job and per-tenant views agree."""
+    from titan_tpu.olap.serving.hbm import snapshot_csr_bytes
+
+    m = MetricManager()
+    sched = JobScheduler(snapshot=snap_main, metrics=m,
+                         autostart=False)
+    try:
+        rng = np.random.default_rng(7)
+        nz = np.flatnonzero(np.asarray(snap_main.out_degree) > 0)
+        sources = rng.choice(nz, size=8, replace=True)
+        jobs = [sched.submit(JobSpec(
+            kind="bfs", params={"source_dense": int(s)},
+            tenant="alpha" if i < 6 else "beta"))
+            for i, s in enumerate(sources)]
+        sched.start()
+        for j in jobs:
+            assert j.wait(120)
+        assert all(j.batch_k == 8 for j in jobs), \
+            [j.batch_k for j in jobs]
+        rows = sched.tenant_stats()["tenants"]
+        a, b = rows["alpha"], rows["beta"]
+        assert a["device_seconds"] > 0 and b["device_seconds"] > 0
+        assert a["device_seconds"] == pytest.approx(
+            3 * b["device_seconds"])
+        assert a["hbm_byte_seconds"] == pytest.approx(
+            3 * b["hbm_byte_seconds"])
+        # per-job view consistent with the tenant rollup
+        assert sum(j.device_seconds for j in jobs) == pytest.approx(
+            a["device_seconds"] + b["device_seconds"])
+        # byte-seconds derive from the leased image's ledger bytes
+        nbytes = snapshot_csr_bytes(snap_main)
+        wall = sum(j.device_seconds for j in jobs)
+        assert a["hbm_byte_seconds"] + b["hbm_byte_seconds"] == \
+            pytest.approx(nbytes * wall, rel=1e-6)
+        # nothing held once the batch finished
+        assert a["hbm_running_bytes"] == 0.0
+        # wire envelope carries the attribution
+        w = jobs[0].to_wire()
+        assert w["device_ms"] > 0 and w["hbm_byte_seconds"] > 0
+    finally:
+        sched.close()
+
+
+# --------------------------------------------------------------------------
+# no-tenant regression: pre-label surfaces unchanged
+# --------------------------------------------------------------------------
+
+def test_no_tenant_quotas_off_pre_label_surfaces_unchanged(snap_main):
+    """ISSUE 8 acceptance: with no tenant set and quotas off, the
+    metric NAMES, the ``snapshot()`` schema, and the Prometheus parent
+    lines are exactly the pre-label ones — and no serving.tenant.*
+    counter ever moves."""
+    from titan_tpu.obs.promexport import render_prometheus
+
+    m = MetricManager()
+    sched = JobScheduler(snapshot=snap_main, metrics=m)
+    try:
+        for _ in range(3):
+            assert sched.submit(_callable_spec()).wait(30)
+        deadline = time.time() + 10
+        while time.time() < deadline and m.counter_value(
+                "serving.jobs.completed") < 3:
+            time.sleep(0.01)
+        snap = m.snapshot()
+        assert set(snap) == {"serving.jobs.submitted",
+                             "serving.jobs.completed",
+                             "serving.queue.depth",
+                             "serving.job.latency_ms",
+                             "serving.job.queue_ms",
+                             "serving.batch.occupancy"}
+        # unified pre-label schema: counters {type, count}
+        assert snap["serving.jobs.completed"] == {"type": "counter",
+                                                  "count": 3}
+        assert m.counter_value("serving.tenant.throttled") == 0
+        assert m.counter_value("serving.tenant.rejected") == 0
+        # parent exposition lines identical to a never-labeled registry
+        plain = MetricManager()
+        plain.counter("serving.jobs.submitted").inc(3)
+        plain.counter("serving.jobs.completed").inc(3)
+        want = [ln for ln in render_prometheus(plain).splitlines()
+                if ln.startswith("serving_jobs_")]
+        got = render_prometheus(m).splitlines()
+        for ln in want:
+            assert ln in got, ln
+    finally:
+        sched.close()
+
+
+# --------------------------------------------------------------------------
+# queue depth by priority class (satellite)
+# --------------------------------------------------------------------------
+
+def test_queue_depth_labeled_by_priority_class(snap_main):
+    m = MetricManager()
+    sched = JobScheduler(snapshot=snap_main, autostart=False,
+                         metrics=m)
+    try:
+        for prio in (0, 0, 5):
+            sched.submit(_callable_spec(priority=prio))
+        assert m.counter_value("serving.queue.depth") == 3
+        assert m.counter_value("serving.queue.depth",
+                               labels={"priority": "0"}) == 2
+        assert m.counter_value("serving.queue.depth",
+                               labels={"priority": "5"}) == 1
+        # flagged bidirectional → renders as a Prometheus gauge
+        from titan_tpu.obs.promexport import render_prometheus
+        text = render_prometheus(m)
+        assert "# TYPE serving_queue_depth gauge" in text
+        assert 'serving_queue_depth{priority="0"} 2' in text
+        sched.start()
+        for j in sched.jobs():
+            assert j.wait(30)
+        deadline = time.time() + 10
+        while time.time() < deadline and m.counter_value(
+                "serving.queue.depth") != 0:
+            time.sleep(0.01)
+        # drained: children AND parent back to zero (labeled pops)
+        assert m.counter_value("serving.queue.depth") == 0
+        assert m.counter_value("serving.queue.depth",
+                               labels={"priority": "0"}) == 0
+        assert m.counter_value("serving.queue.depth",
+                               labels={"priority": "5"}) == 0
+    finally:
+        sched.close()
+
+
+# --------------------------------------------------------------------------
+# HBM / pool gauges (satellite)
+# --------------------------------------------------------------------------
+
+def test_hbm_and_pool_gauges_exported(snap_main):
+    """HBMLedger residency and snapshot-pool size export as REAL gauges
+    (callback views read at scrape time) — resident_bytes was computed
+    but never exported before ISSUE 8."""
+    from titan_tpu.obs.promexport import render_prometheus
+    from titan_tpu.olap.serving.hbm import snapshot_csr_bytes
+
+    m = MetricManager()
+    sched = JobScheduler(snapshot=snap_main, metrics=m)
+    try:
+        j = sched.submit(JobSpec(kind="bfs",
+                                 params={"source_dense": 0}))
+        assert j.wait(120)
+        nbytes = snapshot_csr_bytes(snap_main)
+        assert m.gauge_value("serving.hbm.resident_bytes") == nbytes
+        # nothing pinned after the batch drains
+        assert m.gauge_value("serving.hbm.pinned_bytes") == 0.0
+        assert m.gauge_value("serving.pool.snapshots") >= 1.0
+        text = render_prometheus(m)
+        assert "# TYPE serving_hbm_resident_bytes gauge" in text
+        assert "# TYPE serving_hbm_pinned_bytes gauge" in text
+        assert "# TYPE serving_pool_snapshots gauge" in text
+        assert f"serving_hbm_resident_bytes {nbytes}" in text
+    finally:
+        sched.close()
+
+
+def test_quota_check_and_admit_atomic_under_concurrent_submits(
+        snap_main):
+    """Enforced max_in_flight must hold under CONCURRENT submits (the
+    HTTP server runs handlers in parallel): with a limit of 4 and 16
+    racing submitters, exactly 4 are admitted — the check and the
+    reservation are one critical section, not read-then-write."""
+    m = MetricManager()
+    sched = JobScheduler(
+        snapshot=snap_main, metrics=m, autostart=False,
+        enforce_quotas=True,
+        quotas={"flood": TenantQuota(max_in_flight=4)})
+    admitted: list = []
+    refused: list = []
+    lock = threading.Lock()
+
+    def submitter():
+        try:
+            j = sched.submit(_callable_spec(tenant="flood"))
+            with lock:
+                admitted.append(j)
+        except QuotaExceeded as e:
+            with lock:
+                refused.append(e)
+
+    try:
+        threads = [threading.Thread(target=submitter)
+                   for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert len(admitted) == 4, (len(admitted), len(refused))
+        assert len(refused) == 12
+        rows = sched.tenant_stats()["tenants"]["flood"]
+        assert rows["in_flight"] == 4
+        assert rows["submitted"] == 4
+        assert rows["rejected"] == 12
+        assert m.counter_value("serving.tenant.rejected",
+                               labels={"tenant": "flood"}) == 12
+        # rejected submits never counted as submitted
+        assert m.counter_value("serving.jobs.submitted") == 4
+        sched.start()
+        for j in admitted:
+            assert j.wait(30)
+    finally:
+        sched.close()
+
+
+def test_closed_scheduler_rejection_releases_quota_reservation(
+        snap_main):
+    """A submit refused because the scheduler closed must back out its
+    quota reservation — otherwise rejected submits pin in-flight slots
+    forever."""
+    sched = JobScheduler(snapshot=snap_main, autostart=False,
+                         metrics=MetricManager(),
+                         quotas={"t": TenantQuota(max_in_flight=1)},
+                         enforce_quotas=True)
+    sched.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        sched.submit(_callable_spec(tenant="t"))
+    rows = sched.tenant_stats()["tenants"]["t"]
+    assert rows["in_flight"] == 0 and rows["submitted"] == 0
+
+
+def test_non_executed_jobs_record_no_latency_sample(snap_main):
+    """Expired-at-submit and cancelled-while-queued jobs never entered
+    execution: they must not drop ~0ms samples into the latency
+    histogram, where they would drag the p95 down and dilute the SLO
+    engine's latency SLI for their tenant."""
+    m = MetricManager()
+    sched = JobScheduler(snapshot=snap_main, metrics=m,
+                         autostart=False)
+    try:
+        expired = sched.submit(_callable_spec(
+            tenant="a", deadline=time.time() - 1))
+        assert expired.state.value == "expired"
+        queued = sched.submit(_callable_spec(tenant="a"))
+        assert sched.cancel(queued.id)
+        assert m.counter_value("serving.jobs.expired") == 1
+        assert m.counter_value("serving.jobs.cancelled") == 1
+        assert m.histogram("serving.job.latency_ms").count == 0
+        # an executed job still samples exactly once
+        ran = sched.submit(_callable_spec(tenant="a"))
+        sched.start()
+        assert ran.wait(30)
+        assert m.histogram("serving.job.latency_ms").count == 1
+    finally:
+        sched.close()
+
+
+def test_failed_submit_backs_out_quota_reservation(snap_main):
+    """A submit that raises AFTER the quota gate (junk deadline type →
+    TypeError at the deadline comparison) must release the tenant's
+    in-flight reservation — otherwise a few malformed submits lock the
+    tenant out of an enforced max_in_flight quota forever."""
+    m = MetricManager()
+    sched = JobScheduler(
+        snapshot=snap_main, metrics=m, autostart=False,
+        enforce_quotas=True,
+        quotas={"t": TenantQuota(max_in_flight=1)})
+    try:
+        with pytest.raises(TypeError):
+            sched.submit(_callable_spec(tenant="t", deadline="60"))
+        rows = sched.tenant_stats()["tenants"]["t"]
+        assert rows["in_flight"] == 0 and rows["submitted"] == 0
+        # the slot is free: a well-formed submit is admitted
+        job = sched.submit(_callable_spec(tenant="t"))
+        sched.start()
+        assert job.wait(30)
+    finally:
+        sched.close()
+
+
+def test_scheduler_close_detaches_slo_burn_gauges(snap_main):
+    """close() must neutralize the SLO engine's burn-rate gauge
+    callbacks along with the hbm/pool ones — a dead scheduler's engine
+    must not keep re-evaluating objectives on every scrape."""
+    from titan_tpu.obs.slo import SLO
+    m = MetricManager()
+    sched = JobScheduler(
+        snapshot=snap_main, metrics=m, autostart=False,
+        slos=[SLO("t-avail", tenant="t", success_rate=0.9,
+                  windows=(300.0,))])
+    m.counter("serving.jobs.failed",
+              labels={"tenant": "t", "kind": "callable"}).inc(3)
+    assert m.gauge_value("serving.slo.burn_rate",
+                         labels={"slo": "t-avail",
+                                 "window": "300s"}) > 0
+    sched.close()
+    assert m.gauge_value("serving.slo.burn_rate",
+                         labels={"slo": "t-avail",
+                                 "window": "300s"}) == 0.0

@@ -11,12 +11,18 @@ multi-chip scheduler cannot be debugged without):
   journal per trace. Pure host-side bookkeeping: the kernels' existing
   round-boundary host callbacks feed it, never device code.
 * ``promexport`` — renders the ``utils.metrics`` registry (counters /
-  timers / histograms) as Prometheus text exposition, served by
-  ``GET /metrics`` on the HTTP server.
+  timers / histograms / gauges, labeled children included) as
+  Prometheus text exposition, served by ``GET /metrics`` on the HTTP
+  server.
+* ``slo`` — declarative per-tenant / per-algorithm objectives
+  (p95-latency, success-rate) evaluated from the labeled metric
+  children into multi-window error-budget burn rates (``GET /slo``,
+  ``serving.slo.burn_rate`` gauges).
 
 docs/observability.md documents the span model and endpoints.
 """
 
 from titan_tpu.obs.promexport import CONTENT_TYPE, render_prometheus  # noqa: F401
+from titan_tpu.obs.slo import SLO, SLOEngine  # noqa: F401
 from titan_tpu.obs.tracing import (NULL_SPAN, Span, TraceHandle,  # noqa: F401
                                    Tracer, trace_summary)

@@ -279,7 +279,13 @@ class JobSpec:
     ``checkpoint_dir``) captures the program state every N round
     boundaries so a retried attempt resumes from the newest valid
     checkpoint instead of restarting, bit-equal to an uninterrupted
-    run. Cancellation, timeout and param errors never retry."""
+    run. Cancellation, timeout and param errors never retry.
+
+    Tenancy (olap/serving/tenants): ``tenant`` attributes the job's
+    queue-ms / device-seconds / HBM-byte-seconds / replayed-rounds to a
+    named tenant, labels its metrics and trace, and subjects it to that
+    tenant's quota when the scheduler enforces quotas; unset/empty
+    falls back to ``"default"`` everywhere."""
 
     kind: str
     params: dict = field(default_factory=dict)
@@ -292,6 +298,7 @@ class JobSpec:
     max_retries: int = 0
     checkpoint_every: int = 0
     retry_backoff_s: float = 0.05
+    tenant: Optional[str] = None
 
 
 class DenseProgram(abc.ABC):

@@ -19,6 +19,13 @@ admission-controlled asynchronous job plane over the TPU engine:
 * ``scheduler`` — priority queue + admission + worker, with per-job
                   latency / queue-depth / batch-occupancy metrics
                   through utils/metrics.
+* ``tenants``   — per-tenant resource attribution (queue-ms /
+                  device-seconds / HBM byte-seconds / replayed rounds)
+                  and quota admission (``TenantQuota``, enforced at
+                  submit behind ``JobScheduler(enforce_quotas=True)``;
+                  shadow-counted otherwise). ``GET /tenants`` +
+                  ``GET /slo`` expose the plane; docs/monitoring.md
+                  documents the label/tenant model.
 
 ``server.py`` exposes this as ``POST /jobs`` / ``GET /jobs/<id>`` /
 ``DELETE /jobs/<id>``; docs/serving.md documents the contract. The
@@ -30,3 +37,6 @@ requeue + deterministic resume from superstep checkpoints) lives in
 
 from titan_tpu.olap.serving.jobs import Job, JobState            # noqa: F401
 from titan_tpu.olap.serving.scheduler import JobScheduler        # noqa: F401
+from titan_tpu.olap.serving.tenants import (DEFAULT_TENANT,      # noqa: F401
+                                            QuotaExceeded,
+                                            TenantQuota)

@@ -70,6 +70,14 @@ class HBMLedger:
         with self._lock:
             return sum(self._bytes.values())
 
+    def pinned_bytes(self) -> int:
+        """Bytes held by PINNED entries (graphs under a running batch)
+        — the unevictable share of ``resident_bytes``; exported as the
+        ``serving.hbm.pinned_bytes`` gauge."""
+        with self._lock:
+            return sum(b for k, b in self._bytes.items()
+                       if self._pins.get(k, 0) > 0)
+
     def reserve(self, key, nbytes: int) -> None:
         evicted = []
         with self._lock:

@@ -64,12 +64,24 @@ class Job:
     single execution) — the amortization evidence per job."""
 
     def __init__(self, spec: JobSpec):
+        from titan_tpu.olap.serving.tenants import effective_tenant
         self.id = f"job-{next(_ids)}"
         self.spec = spec
         self.state = JobState.QUEUED
         self.result: Optional[dict] = None
         self.error: Optional[str] = None
         self.batch_k: int = 0
+        # tenancy (olap/serving/tenants): the attribution identity —
+        # absent/empty spec.tenant falls back to "default", never a
+        # KeyError downstream. device_seconds / hbm_byte_seconds
+        # accumulate the job's batch-share of device wall time and
+        # ledger bytes x seconds across attempts (the scheduler feeds
+        # the per-tenant accounting as it goes; these are the per-job
+        # view for the wire envelope)
+        self.tenant: str = effective_tenant(getattr(spec, "tenant",
+                                                    None))
+        self.device_seconds: float = 0.0
+        self.hbm_byte_seconds: float = 0.0
         self.submitted_at = time.time()
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -221,12 +233,17 @@ class Job:
             "kind": self.spec.kind,
             "status": self.state.value,
             "priority": self.spec.priority,
+            "tenant": self.tenant,
             "submitted_at": self.submitted_at,
             "started_at": self.started_at,
             "finished_at": self.finished_at,
             "batch_k": self.batch_k,
             "attempt": self.attempt,
         }
+        if self.device_seconds:
+            out["device_ms"] = round(self.device_seconds * 1e3, 3)
+        if self.hbm_byte_seconds:
+            out["hbm_byte_seconds"] = round(self.hbm_byte_seconds, 3)
         if self.ran_epoch is not None:
             out["epoch"] = self.ran_epoch
         if self.spec.max_retries:

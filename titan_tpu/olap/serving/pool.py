@@ -252,6 +252,11 @@ class SnapshotPool:
     def stats(self) -> dict:
         with self._lock:
             out = {"entries": len(self._entries),
+                   # resident snapshots incl. a fixed one (the
+                   # serving.pool.snapshots gauge; "entries" predates
+                   # it and counts only the keyed build cache)
+                   "snapshots": len(self._entries)
+                   + (1 if self._fixed is not None else 0),
                    "active_leases": sum(self._leases.values()),
                    "retired": len(self._retired)}
         if self._live is not None:

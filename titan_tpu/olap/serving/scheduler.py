@@ -26,7 +26,10 @@ Metrics (utils/metrics.MetricManager):
   serving.jobs.rejected          (submits refused by admission — closed
                                   scheduler / unknown kind; NOT counted
                                   as submitted)
-  serving.queue.depth            (counter, inc on enqueue / dec on pop)
+  serving.queue.depth            (gauge-flagged counter, inc on enqueue
+                                  / dec on pop; labeled children break
+                                  the depth out by priority class so
+                                  head-of-line blocking is visible)
   serving.job.latency_ms         (histogram: submit → terminal, p50/p95)
   serving.job.queue_ms           (histogram: submit → start)
   serving.batch.occupancy        (histogram: K per executed batch)
@@ -34,6 +37,20 @@ Metrics (utils/metrics.MetricManager):
   serving.recovery.invalid_checkpoints (digest-rejected at resume)
   serving.recovery.resumes / .rounds_replayed
   serving.recovery.retries / .retries_exhausted
+  serving.tenant.{rejected,throttled}  (quota admissions, by tenant)
+  serving.hbm.{resident_bytes,pinned_bytes} + serving.pool.snapshots
+                                 (callback gauges over the ledger/pool)
+
+Tenancy (olap/serving/tenants, ISSUE 8): every job belongs to a tenant
+(``spec.tenant``, falling back to "default"); the per-job counters and
+latency/queue histograms write through {kind, tenant}-labeled children
+that sum exactly into the unlabeled parents, and the scheduler accounts
+queue-ms / device-seconds (batch wall split across the K fused jobs) /
+HBM byte-seconds / replayed-rounds per tenant (``tenant_stats()`` →
+``GET /tenants``). Per-tenant quotas check at submit() behind
+``enforce_quotas`` (default OFF: violations are admitted but counted as
+throttled — observable-first); ``slos=[obs.slo.SLO(...)]`` attaches the
+SLO engine (``slo_report()`` → ``GET /slo``, burn-rate gauges).
 
 Tracing (titan_tpu/obs, ISSUE r10): one trace per job (trace id ==
 job id) — ``submit`` / ``queue`` / per-attempt ``attempt`` spans open
@@ -60,6 +77,9 @@ from titan_tpu.olap.serving.hbm import (DEFAULT_BUDGET_BYTES,
                                         snapshot_csr_bytes)
 from titan_tpu.olap.serving.jobs import Job, JobState
 from titan_tpu.olap.serving.pool import SnapshotPool
+from titan_tpu.olap.serving.tenants import (QuotaExceeded,
+                                            TenantAccounting,
+                                            effective_tenant)
 from titan_tpu.utils.metrics import MetricManager
 
 #: job kinds that execute against a pooled snapshot (everything except
@@ -78,7 +98,10 @@ class JobScheduler:
                  autostart: bool = True,
                  checkpoint_dir: Optional[str] = None,
                  live=None, tracer: Optional[Tracer] = None,
-                 tracing: Optional[bool] = None):
+                 tracing: Optional[bool] = None,
+                 quotas: Optional[dict] = None,
+                 enforce_quotas: bool = False,
+                 slos=None, slo_clock=None):
         # observability plane (titan_tpu/obs): one tracer per scheduler,
         # one trace per job (trace id == job id) — submit/queue/attempt
         # spans here, fuse/run/round/checkpoint spans in the batcher &
@@ -108,6 +131,38 @@ class JobScheduler:
         self.batcher = Batcher(max_batch=max_batch)
         self.max_batch = max_batch
         self._metrics = metrics or MetricManager.instance()
+        # tenancy plane (olap/serving/tenants): authoritative per-tenant
+        # attribution behind GET /tenants; quotas check at submit()
+        # behind the enforce flag (default OFF = shadow mode: violations
+        # admitted but counted throttled)
+        self.tenants = TenantAccounting()
+        self.quotas = dict(quotas or {})
+        self.enforce_quotas = bool(enforce_quotas)
+        # first-class gauges (utils/metrics.Gauge): HBM residency and
+        # pool size as live callback views. queue depth stays a counter
+        # (its counter_value contract predates gauges) flagged
+        # bidirectional so the Prometheus exposition types it gauge.
+        # The (gauge, fn) pairs are kept so close() can neutralize the
+        # callbacks: the registry may be process-global, and a closed
+        # scheduler's closures would otherwise pin its pool/ledger
+        # forever and keep scraping dead residency numbers
+        self._metrics.counter("serving.queue.depth", gauge=True)
+        self._gauges = []
+        for name, fn in (
+                ("serving.hbm.resident_bytes",
+                 self.ledger.resident_bytes),
+                ("serving.hbm.pinned_bytes", self.ledger.pinned_bytes),
+                ("serving.pool.snapshots",
+                 lambda: self.pool.stats()["snapshots"])):
+            self._gauges.append((self._metrics.gauge(name, fn), fn))
+        # SLO engine (obs/slo): declarative objectives over the labeled
+        # children this scheduler writes; burn rates export as gauges
+        self.slo = None
+        if slos:
+            from titan_tpu.obs.slo import SLOEngine
+            self.slo = SLOEngine(self._metrics, slos,
+                                 clock=slo_clock)
+            self.slo.register_gauges()
         # recovery plane: one store for every job's checkpoints, keyed
         # by a per-scheduler nonce + job id (job ids restart at job-1
         # per process while the store persists on disk — a restarted
@@ -164,6 +219,15 @@ class JobScheduler:
         self.pool.close()
         if self.live is not None:
             self.live.close()
+        # detach OUR gauge callbacks (identity-checked: a successor
+        # scheduler that already re-registered over the same names
+        # must not be clobbered) — the gauges read 0.0 afterwards
+        for g, fn in self._gauges:
+            if g.fn is fn:
+                g.fn = None
+                g.set(0.0)
+        if self.slo is not None:
+            self.slo.detach_gauges()
 
     def _evict(self, key) -> None:
         """HBM eviction: drop the snapshot's cached device CSR (arrays
@@ -182,12 +246,21 @@ class JobScheduler:
 
     # -- submission surface --------------------------------------------------
 
+    def _job_labels(self, job: Job) -> dict:
+        """The {kind, tenant} label set the per-job metric children
+        carry — bounded: kind is validated at admission, tenant
+        cardinality is capped by the registry's MAX_CHILDREN guard."""
+        return {"kind": job.spec.kind, "tenant": job.tenant}
+
     def submit(self, spec: JobSpec) -> Job:
+        tenant = effective_tenant(getattr(spec, "tenant", None))
         # rejected submits must NOT count as submitted (the counter
         # moves only after admission): unknown kinds and closed-
         # scheduler refusals are serving.jobs.rejected instead
         if spec.kind not in _KNOWN_KINDS:
-            self._metrics.counter("serving.jobs.rejected").inc()
+            self._metrics.counter(
+                "serving.jobs.rejected",
+                labels={"kind": "unknown", "tenant": tenant}).inc()
             raise ValueError(f"unknown job kind {spec.kind!r} "
                              f"(known: {', '.join(_KNOWN_KINDS)})")
         faults = spec.params.get("faults") \
@@ -198,14 +271,45 @@ class JobScheduler:
                 # an arbitrary wire value here would detonate inside
                 # the fused batch's level callback and fail every
                 # batchmate — reject it at admission instead
-                self._metrics.counter("serving.jobs.rejected").inc()
+                self._metrics.counter(
+                    "serving.jobs.rejected",
+                    labels={"kind": spec.kind, "tenant": tenant}).inc()
                 raise ValueError("params['faults'] must be a "
                                  "recovery.FaultPlan (test harness "
                                  "only, not wire-settable)")
+        # tenant quota gate (olap/serving/tenants): check + reservation
+        # are ONE atomic step (concurrent submits racing a max_in_flight
+        # limit must not both read "below limit" and both admit).
+        # Enforcement is flagged, default off — a violating submit in
+        # shadow mode is admitted but counted, so admission control
+        # lands observable-first
+        why = self.tenants.admit(tenant, self.quotas.get(tenant),
+                                 self.enforce_quotas)
+        if why is not None:
+            if self.enforce_quotas:
+                self._metrics.counter("serving.tenant.rejected",
+                                      labels={"tenant": tenant}).inc()
+                raise QuotaExceeded(f"tenant {tenant!r}: {why}")
+            self._metrics.counter("serving.tenant.throttled",
+                                  labels={"tenant": tenant}).inc()
+        # from here the tenant holds an in-flight reservation: ANY
+        # raise before the job is actually accepted (closed scheduler,
+        # junk deadline type, recovery-plan construction, ...) must
+        # back it out, or failed submits pin quota slots forever
+        try:
+            return self._submit_admitted(spec, faults)
+        except BaseException:
+            self.tenants.unadmit(tenant)
+            raise
+
+    def _submit_admitted(self, spec: JobSpec, faults) -> Job:
+        """Post-quota-gate tail of ``submit``: the caller owns the
+        tenant's admission reservation and backs it out if we raise."""
         job = Job(spec)
         if self.tracer.enabled:
             root = self.tracer.start(job.id, "job", kind=spec.kind,
-                                     priority=spec.priority)
+                                     priority=spec.priority,
+                                     tenant=job.tenant)
             job.trace = TraceHandle(self.tracer, job.id, root)
             job.trace.event("submit", parent=root)
         store = self.ckpt_store \
@@ -219,7 +323,10 @@ class JobScheduler:
                 key=f"{self._ckpt_ns}-{job.id}" if store is not None
                 else None)
         if spec.deadline is not None and time.time() > spec.deadline:
-            self._metrics.counter("serving.jobs.submitted").inc()
+            # tenant admission was already reserved by tenants.admit
+            self._metrics.counter(
+                "serving.jobs.submitted",
+                labels=self._job_labels(job)).inc()
             job.expire()
             self._finalize_metrics(job)
             with self._cv:
@@ -227,19 +334,32 @@ class JobScheduler:
             return job
         with self._cv:
             if self._stop:
-                self._metrics.counter("serving.jobs.rejected").inc()
+                self._metrics.counter(
+                    "serving.jobs.rejected",
+                    labels=self._job_labels(job)).inc()
                 # the job was never admitted: drop its just-opened
-                # trace, or rejected submits would pile never-ending
-                # root spans into the tracer's LRU
+                # trace (or rejected submits would pile never-ending
+                # root spans into the tracer's LRU); the quota
+                # reservation is backed out by submit()'s except
                 self.tracer.discard(job.id)
                 raise RuntimeError("scheduler is closed")
-            self._metrics.counter("serving.jobs.submitted").inc()
+            self._metrics.counter(
+                "serving.jobs.submitted",
+                labels=self._job_labels(job)).inc()
             self._jobs[job.id] = job
             if job.trace is not None:
                 job.trace.queue = job.trace.start(
                     "queue", parent=job.trace.root)
             self._push_locked(job)
         return job
+
+    def _depth(self, job: Job, n: int) -> None:
+        """Queue-depth move, labeled by the job's priority class — the
+        child rolls up into the unlabeled total, and the per-priority
+        breakout makes head-of-line blocking visible on /metrics."""
+        self._metrics.counter(
+            "serving.queue.depth",
+            labels={"priority": str(job.spec.priority)}).inc(n)
 
     def _push_locked(self, job: Job) -> None:
         """Heap insert (priority desc, deadline asc, FIFO) + depth/
@@ -251,7 +371,7 @@ class JobScheduler:
                         if job.spec.deadline is not None
                         else float("inf"),
                         next(self._seq), job))
-        self._metrics.counter("serving.queue.depth").inc()
+        self._depth(job, 1)
         self._cv.notify()
 
     def get(self, job_id: str) -> Optional[Job]:
@@ -277,6 +397,22 @@ class JobScheduler:
         """The live plane's freshness/overlay/compaction stats
         (``GET /live``); None when no plane is attached."""
         return self.live.stats() if self.live is not None else None
+
+    def tenant_stats(self) -> dict:
+        """Per-tenant attribution + quota view (``GET /tenants``):
+        the accounting rows (queue-ms, device-seconds, HBM
+        byte-seconds, replayed rounds, in-flight, admissions) plus the
+        configured quotas and the enforcement flag."""
+        return {"enforce_quotas": self.enforce_quotas,
+                "tenants": self.tenants.stats(),
+                "quotas": {t: q.to_wire()
+                           for t, q in sorted(self.quotas.items())}}
+
+    def slo_report(self) -> Optional[dict]:
+        """The SLO engine's full evaluation (``GET /slo``): per
+        objective, the current SLI and the multi-window error-budget
+        burn rates; None when no objectives are attached."""
+        return self.slo.evaluate() if self.slo is not None else None
 
     def trace_summary(self, job_id: str) -> Optional[dict]:
         """Per-job trace digest (queue_ms / fuse_ms / device_ms /
@@ -327,12 +463,25 @@ class JobScheduler:
             h.event(job.state.value, parent=h.root)
             h.end(h.root, status=job.state.value,
                   **({"error": job.error} if job.error else {}))
-        self._metrics.counter(f"serving.jobs.{name}").inc()
+        self._metrics.counter(f"serving.jobs.{name}",
+                              labels=self._job_labels(job)).inc()
+        # tenant attribution closes out here: the job leaves in-flight,
+        # its terminal state lands in the per-tenant row, and any
+        # recovery-plane replay it caused is charged to its tenant
+        self.tenants.finished(job.tenant, name,
+                              rounds_replayed=job.rounds_replayed)
         if job.retries_exhausted:
             self._metrics.counter(
                 "serving.recovery.retries_exhausted").inc()
-        if job.finished_at is not None:
-            self._metrics.histogram("serving.job.latency_ms").update(
+        if job.finished_at is not None and job.started_at is not None:
+            # jobs that never entered execution (cancelled while
+            # queued, expired at submit) record NO latency sample:
+            # their ~0ms "latencies" would drag the p95 down and
+            # dilute the SLO engine's latency SLI — a tenant flooding
+            # expired jobs must not mask its real jobs' breaches
+            self._metrics.histogram(
+                "serving.job.latency_ms",
+                labels=self._job_labels(job)).update(
                 (job.finished_at - job.submitted_at) * 1e3)
 
     def _pop_group(self) -> list[Job]:
@@ -347,7 +496,7 @@ class JobScheduler:
             entry = heapq.heappop(self._heap)
             job = entry[3]
             if job.state not in (JobState.QUEUED, JobState.RETRYING):
-                self._metrics.counter("serving.queue.depth").inc(-1)
+                self._depth(job, -1)
                 continue       # cancelled while queued (already terminal)
             if job.not_before is not None and time.time() < job.not_before:
                 leftovers.append(entry)    # backoff not elapsed
@@ -357,20 +506,20 @@ class JobScheduler:
                     time.time() > job.spec.deadline:
                 # start-deadline applies to the FIRST start only: a
                 # RETRYING job already met it
-                self._metrics.counter("serving.queue.depth").inc(-1)
+                self._depth(job, -1)
                 if job.expire():
                     self._finalize_metrics(job)
                 continue
             if not group:
                 group.append(job)
-                self._metrics.counter("serving.queue.depth").inc(-1)
+                self._depth(job, -1)
                 key = batch_key(job.spec)
                 if key is None:
                     break      # unbatchable head runs alone
                 continue
             if batch_key(job.spec) == key and len(group) < self.max_batch:
                 group.append(job)
-                self._metrics.counter("serving.queue.depth").inc(-1)
+                self._depth(job, -1)
                 if len(group) >= self.max_batch:
                     break      # full batch: stop draining the heap
             else:
@@ -439,6 +588,26 @@ class JobScheduler:
                 else:
                     self._finalize_metrics(job)
 
+    def _attribute(self, group: list[Job], wall: float,
+                   nbytes: int) -> None:
+        """Resource attribution for one executed batch: the shared
+        level loop served all K jobs at once, so the batch wall time —
+        and the leased graph image's ledger bytes × that wall — split
+        EVENLY across the K members (the amortization-aware split; a
+        job's fused cost IS wall/K, that being the whole point of
+        fusion). Accumulates on both the per-job view (wire envelope)
+        and the per-tenant ledger."""
+        if not group or wall <= 0:
+            return
+        dev_share = wall / len(group)
+        hbm_share = nbytes * wall / len(group)
+        for job in group:
+            job.device_seconds += dev_share
+            job.hbm_byte_seconds += hbm_share
+            self.tenants.device_seconds(job.tenant, dev_share)
+            if hbm_share:
+                self.tenants.hbm_byte_seconds(job.tenant, hbm_share)
+
     def _execute(self, group: list[Job]) -> None:
         head = group[0]
         # cancel raced between pop and start: honor it before any work
@@ -460,13 +629,17 @@ class JobScheduler:
             # retry attempts keep the FIRST start time: sample the
             # submit->start latency once per job, not once per attempt
             if q is not None and first_start:
-                self._metrics.histogram("serving.job.queue_ms").update(
-                    q * 1e3)
+                self._metrics.histogram(
+                    "serving.job.queue_ms",
+                    labels=self._job_labels(job)).update(q * 1e3)
+                self.tenants.queue_ms(job.tenant, q * 1e3)
         self._metrics.histogram("serving.batch.occupancy").update(
             float(len(group)))
         if head.spec.kind == "callable":
+            t0 = time.time()
             for job in group:
                 self.batcher.run_single(job, None)
+            self._attribute(group, time.time() - t0, 0)
             return
         spec = head.spec
         edge_keys = tuple(spec.edge_keys or ())
@@ -504,6 +677,16 @@ class JobScheduler:
                     job.fail(str(e))
                 return
             self._evictable.setdefault(ledger_key, snap)
+            # the batch shares one graph image: its ledger bytes are
+            # held against each member's tenant (per-K share) for the
+            # duration of the run — the live view max_hbm_bytes quotas
+            # check against — then released and converted into
+            # byte-seconds attribution
+            nbytes = snapshot_csr_bytes(snap)
+            share = nbytes / len(group)
+            for job in group:
+                self.tenants.hold_hbm(job.tenant, share)
+            t0 = time.time()
             try:
                 if len(group) > 1 or batch_key(spec) is not None:
                     self.batcher.run_bfs_batch(group, snap,
@@ -512,4 +695,8 @@ class JobScheduler:
                     self.batcher.run_single(group[0], snap,
                                             overlay=overlay)
             finally:
+                wall = time.time() - t0
+                for job in group:
+                    self.tenants.drop_hbm(job.tenant, share)
+                self._attribute(group, wall, nbytes)
                 self.ledger.unpin(ledger_key)

@@ -19,12 +19,18 @@ Endpoints:
                       {"kind": "bfs", "source": <vertex id>, ...,
                        "priority": 0, "timeout_s": 30, "deadline_s": 60,
                        "targets": [ids], "max_retries": 0,
-                       "checkpoint_every": 0} → 202 {"job": id}.
+                       "checkpoint_every": 0, "tenant": "team-a"}
+                      → 202 {"job": id}.
                       Same-snapshot BFS jobs fuse into one batched
                       [K, n] device run; max_retries/checkpoint_every
                       opt into the recovery plane (olap/recovery —
                       RETRYING + resume-from-checkpoint; checkpoints
                       need a scheduler with checkpoint_dir set).
+                      ``tenant`` (optional, defaults "default")
+                      attributes the job's resources and labels its
+                      metrics/trace; a submit refused by a tenant
+                      quota (scheduler with enforce_quotas=True) is
+                      429 + retryable.
   GET    /jobs      — scheduler stats + job summaries (each job's
                       ``epoch`` records the graph state it ran at —
                       live-plane leases carry compaction epoch +
@@ -40,8 +46,18 @@ Endpoints:
   DELETE /jobs/<id> — cancel (queued or retrying: immediate; running:
                       at the next level boundary via the per-job
                       early-exit mask)
+  GET  /tenants     — per-tenant attribution + quota view (ISSUE 8):
+                      queue-ms / device-seconds / HBM byte-seconds /
+                      replayed rounds / in-flight and admission
+                      counts per tenant, plus the configured quotas
+                      and the enforcement flag
+  GET  /slo         — SLO engine report (obs/slo): per objective the
+                      current SLI and multi-window error-budget burn
+                      rates; {"enabled": false} when the scheduler has
+                      no objectives attached
   GET  /metrics     — Prometheus text exposition of every registered
-                      counter/timer/histogram (titan_tpu/obs/promexport;
+                      counter/timer/histogram/gauge, labeled children
+                      included (titan_tpu/obs/promexport;
                       content type ``text/plain; version=0.0.4``)
   GET  /trace?job=<id> — the job's span tree as JSON (obs/tracing:
                       submit→queue→fuse→per-round→checkpoint→retrying→
@@ -105,8 +121,14 @@ def wire_error(e: BaseException) -> tuple[int, dict]:
                                   PermanentBackendError,
                                   SchemaViolationError,
                                   TemporaryBackendError)
+    from titan_tpu.olap.serving.tenants import QuotaExceeded
     name = type(e).__name__
     env = {"error": str(e) or name, "type": name}
+    if isinstance(e, QuotaExceeded):
+        # checked BEFORE the ValueError family it subclasses: a quota
+        # refusal is 429 + retryable (the same request may succeed once
+        # the tenant's load drains), never a 400 caller error
+        return 429, {**env, "retryable": True}
     if isinstance(e, TemporaryBackendError):
         return 503, {**env, "retryable": True}
     if isinstance(e, (SchemaViolationError, InvalidElementError,
@@ -171,6 +193,15 @@ class GraphServer:
         return sched.tracer if sched is not None and not sched.closed \
             else None
 
+    def live_scheduler(self):
+        """The scheduler if one is alive, else None — the read-only
+        observation endpoints (/tenants, /slo) answer from this so a
+        monitoring probe never constructs a worker thread + pool +
+        ledger just to report an empty plane."""
+        with self._sched_lock:
+            sched = self._scheduler
+        return sched if sched is not None and not sched.closed else None
+
     def submit_job(self, body: dict):
         """Wire body → JobSpec → scheduler (shared by POST /jobs and the
         smoke script). ``deadline_s`` is relative to now; params carry
@@ -204,7 +235,8 @@ class GraphServer:
                        directed=bool(body.get("directed", False)),
                        max_retries=int(body.get("max_retries", 0)),
                        checkpoint_every=int(
-                           body.get("checkpoint_every", 0)))
+                           body.get("checkpoint_every", 0)),
+                       tenant=body.get("tenant"))
         return self.scheduler().submit(spec)
 
     # -- script evaluation ---------------------------------------------------
@@ -355,6 +387,26 @@ class GraphServer:
                         self._send(200, {"enabled": False})
                     else:
                         self._send(200, {"enabled": True, **live})
+                elif self.path == "/tenants":
+                    # per-tenant attribution + quota view (ISSUE 8):
+                    # accounting rows, configured quotas, enforcement —
+                    # answered from the LIVE scheduler only (a probe
+                    # must not construct one; cf. metrics_manager)
+                    sched = server.live_scheduler()
+                    self._send(200, sched.tenant_stats()
+                               if sched is not None
+                               else {"enforce_quotas": False,
+                                     "tenants": {}, "quotas": {}})
+                elif self.path == "/slo":
+                    # SLO engine report: per objective, current SLI +
+                    # multi-window error-budget burn rates
+                    sched = server.live_scheduler()
+                    slo = sched.slo_report() if sched is not None \
+                        else None
+                    if slo is None:
+                        self._send(200, {"enabled": False})
+                    else:
+                        self._send(200, {"enabled": True, **slo})
                 elif self.path.startswith("/jobs/"):
                     sched = server.scheduler()
                     job = sched.get(self.path[len("/jobs/"):])
@@ -381,9 +433,15 @@ class GraphServer:
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 if self.path == "/jobs":
+                    from titan_tpu.olap.serving.tenants import \
+                        QuotaExceeded
                     try:
                         body = json.loads(self.rfile.read(length) or b"{}")
                         job = server.submit_job(body)
+                    except QuotaExceeded as e:
+                        # before its ValueError parent: 429 + retryable
+                        self._send(*wire_error(e))
+                        return
                     except (json.JSONDecodeError, ValueError,
                             TypeError) as e:
                         self._send(400, {"error": str(e),

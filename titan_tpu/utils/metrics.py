@@ -13,6 +13,26 @@ TPU-first notes: the registry is pure host-side bookkeeping (nanosecond
 timers around store RPCs); device-side timing comes from JAX profiling, not
 from here. The instrumented wrapper sits *under* the expiration cache so
 cache hits do not count as backend ops — exactly the reference's layering.
+
+Dimensional children (ISSUE 8): ``counter(name, labels={...})`` (and the
+timer/histogram analogs) returns a LABELED CHILD of the unlabeled parent
+— every update lands on both, so the children of a name always sum
+exactly to its parent and every pre-label consumer (``counter_value``,
+``snapshot()``, CSV, the reporters) keeps reading the parent unchanged.
+Children surface only through the dimensional reads (``labeled()`` /
+``children()`` / ``counter_value(name, labels=...)``) and the Prometheus
+exposition (obs/promexport renders them as label sets); ``snapshot()``
+stays byte-identical to the pre-label schema. Label sets are capped per
+name (``MAX_CHILDREN``) — an over-cardinality label set degrades to the
+parent rather than growing the registry without bound.
+
+``Gauge`` is the first-class current-value kind (callback-backed, read
+at scrape time — HBM residency, snapshot-pool size, SLO burn rates);
+gauges live outside ``snapshot()`` (they are views, not accumulations)
+and export through ``gauge_snapshot()`` / Prometheus. Bidirectional
+counters (queue depth inc/dec) are flagged ``gauge=True`` at creation so
+the exposition types them correctly without promexport keeping a name
+allowlist.
 """
 
 from __future__ import annotations
@@ -44,7 +64,13 @@ M_ENTRIES_COUNT = "entries-returned"
 
 @dataclass
 class Counter:
+    #: ``gauge=True`` marks a counter whose value moves in BOTH
+    #: directions (current-level bookkeeping like queue depth) — the
+    #: Prometheus exposition renders it as a gauge, since
+    #: rate()/increase() over a "counter" would read every decrement as
+    #: a counter reset
     count: int = 0
+    gauge: bool = False
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def inc(self, n: int = 1) -> None:
@@ -73,6 +99,19 @@ class Timer:
     @property
     def mean_ns(self) -> float:
         return self.total_ns / self.count if self.count else 0.0
+
+
+def nearest_rank(samples, q: float) -> float:
+    """Nearest-rank percentile (``q`` in [0, 1]) over a value list —
+    THE percentile definition of the whole plane: Histogram.percentile,
+    the SLO engine's pooled p95 and bench's per-tenant lines all call
+    this one function, so they can never drift apart. Unsorted input
+    accepted; empty reads 0.0."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    rank = max(0, min(len(s) - 1, int(round(q * (len(s) - 1)))))
+    return s[rank]
 
 
 class Histogram:
@@ -129,11 +168,8 @@ class Histogram:
     def percentile(self, q: float) -> float:
         """q in [0, 100]; nearest-rank over the reservoir."""
         with self._lock:
-            if not self._samples:
-                return 0.0
-            s = sorted(self._samples)
-        rank = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
-        return s[rank]
+            samples = list(self._samples)
+        return nearest_rank(samples, q / 100.0)
 
     def to_dict(self) -> dict:
         return {"count": self.count, "mean": self.mean, "min": self.min,
@@ -142,6 +178,120 @@ class Histogram:
                 # how many reservoir samples back the percentiles —
                 # below max_samples they are exact, not estimates
                 "samples": len(self._samples)}
+
+    def values(self) -> list:
+        """Reservoir snapshot (unordered) — the SLO engine pools these
+        across labeled children for cross-kind percentiles; under
+        ``max_samples`` updates this is the EXACT value set."""
+        with self._lock:
+            return list(self._samples)
+
+
+class Gauge:
+    """Current-value metric, read at export time. ``fn`` (a zero-arg
+    callable returning a number) makes it a live view — HBM residency,
+    snapshot-pool size, SLO burn rates; without a callback it holds the
+    last ``set()`` value. A raising/broken callback reads as 0.0: a dead
+    gauge must never take a scrape (or a reporter thread) down."""
+
+    __slots__ = ("fn", "_value")
+
+    def __init__(self, fn=None):
+        self.fn = fn
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    def read(self) -> float:
+        if self.fn is None:
+            return self._value
+        try:
+            return float(self.fn())
+        except Exception:
+            return 0.0
+
+
+class _LabeledCounter:
+    """Labeled child handle: increments land on the child AND its
+    unlabeled parent, so children always sum exactly to the parent and
+    every pre-label read of the parent is unchanged."""
+
+    __slots__ = ("child", "parent", "labels")
+
+    def __init__(self, child: Counter, parent: Counter, labels: dict):
+        self.child = child
+        self.parent = parent
+        self.labels = labels
+
+    @property
+    def count(self) -> int:
+        return self.child.count
+
+    def inc(self, n: int = 1) -> None:
+        self.child.inc(n)
+        self.parent.inc(n)
+
+    def stats(self) -> dict:
+        return {"type": "counter", "count": self.child.count}
+
+
+class _LabeledTimer:
+    __slots__ = ("child", "parent", "labels")
+
+    def __init__(self, child: Timer, parent: Timer, labels: dict):
+        self.child = child
+        self.parent = parent
+        self.labels = labels
+
+    @property
+    def count(self) -> int:
+        return self.child.count
+
+    def update(self, elapsed_ns: int) -> None:
+        self.child.update(elapsed_ns)
+        self.parent.update(elapsed_ns)
+
+    def stats(self) -> dict:
+        c = self.child
+        return {"type": "timer", "count": c.count,
+                "mean_ms": c.mean_ns / 1e6, "min_ms": c.min_ns / 1e6,
+                "max_ms": c.max_ns / 1e6, "total_ms": c.total_ns / 1e6}
+
+
+class _LabeledHistogram:
+    __slots__ = ("child", "parent", "labels")
+
+    def __init__(self, child: Histogram, parent: Histogram, labels: dict):
+        self.child = child
+        self.parent = parent
+        self.labels = labels
+
+    @property
+    def count(self) -> int:
+        return self.child.count
+
+    def update(self, value: float) -> None:
+        self.child.update(value)
+        self.parent.update(value)
+
+    def percentile(self, q: float) -> float:
+        return self.child.percentile(q)
+
+    def values(self) -> list:
+        return self.child.values()
+
+    def to_dict(self) -> dict:
+        return self.child.to_dict()
+
+    def stats(self) -> dict:
+        return {"type": "histogram", **self.child.to_dict()}
+
+
+def _labels_key(labels: dict) -> tuple:
+    """Canonical child key: sorted (str(k), str(v)) pairs — label order
+    at the call site never creates a second child."""
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
 class MetricManager:
@@ -152,10 +302,22 @@ class MetricManager:
     _instance: Optional["MetricManager"] = None
     _instance_lock = threading.Lock()
 
+    #: labeled-children cap PER metric name: label values often arrive
+    #: from the wire (tenant ids), and an unbounded label set would let
+    #: one abusive caller grow the registry forever — past the cap a
+    #: NEW label set degrades to the unlabeled parent (existing
+    #: children keep working)
+    MAX_CHILDREN = 256
+
     def __init__(self):
         self._counters: dict[str, Counter] = {}
         self._timers: dict[str, Timer] = {}
         self._histograms: dict[str, Histogram] = {}
+        # name -> {labels_key: _Labeled*} (one family dict per name;
+        # the proxy holds the child metric + the labels dict)
+        self._children: dict[str, dict] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._gauge_children: dict[str, dict] = {}
         self._lock = threading.Lock()
 
     @classmethod
@@ -165,22 +327,58 @@ class MetricManager:
                 cls._instance = MetricManager()
             return cls._instance
 
-    def counter(self, name: str) -> Counter:
+    #: counter name recording every cardinality degrade (see _child) —
+    #: created lazily on the first drop, so a run that never overflows
+    #: has a byte-identical snapshot/export to the pre-label contract
+    LABELS_DROPPED = "metrics.labels.dropped"
+
+    def _child(self, name: str, labels: dict, parent, make, proxy):
+        key = _labels_key(labels)
+        with self._lock:
+            fam = self._children.setdefault(name, {})
+            p = fam.get(key)
+            if p is None:
+                if len(fam) >= self.MAX_CHILDREN:
+                    # cardinality guard: degrade to the parent — but
+                    # NEVER silently. A dropped label set means the
+                    # family's children no longer sum to the parent
+                    # and any per-label reader (SLO selectors,
+                    # /metrics children) is blind to this label set,
+                    # so the degrade itself must be observable.
+                    self._counters.setdefault(
+                        self.LABELS_DROPPED, Counter()).inc()
+                    return parent
+                p = proxy(make(), parent, dict(key))
+                fam[key] = p
+            return p
+
+    def counter(self, name: str, labels: Optional[dict] = None,
+                gauge: bool = False):
+        """Unlabeled parent, or (with ``labels``) the labeled child
+        whose increments roll up into it. ``gauge=True`` flags the name
+        as bidirectional for the Prometheus exposition (sticky once
+        set)."""
         c = self._counters.get(name)
         if c is None:
             with self._lock:
                 c = self._counters.setdefault(name, Counter())
+        if gauge and not c.gauge:
+            c.gauge = True
+        if labels:
+            return self._child(name, labels, c, Counter, _LabeledCounter)
         return c
 
-    def timer(self, name: str) -> Timer:
+    def timer(self, name: str, labels: Optional[dict] = None):
         t = self._timers.get(name)
         if t is None:
             with self._lock:
                 t = self._timers.setdefault(name, Timer())
+        if labels:
+            return self._child(name, labels, t, Timer, _LabeledTimer)
         return t
 
-    def histogram(self, name: str, seed: Optional[int] = None
-                  ) -> Histogram:
+    def histogram(self, name: str, seed: Optional[int] = None,
+                  labels: Optional[dict] = None):
         """``seed`` applies only when this call CREATES the histogram
         (reservoir sampling state is per-instance; see Histogram)."""
         h = self._histograms.get(name)
@@ -188,11 +386,114 @@ class MetricManager:
             with self._lock:
                 h = self._histograms.setdefault(name,
                                                 Histogram(seed=seed))
+        if labels:
+            return self._child(name, labels, h, Histogram,
+                               _LabeledHistogram)
         return h
 
-    def counter_value(self, name: str) -> int:
+    def gauge(self, name: str, fn=None,
+              labels: Optional[dict] = None) -> Gauge:
+        """Get-or-create a gauge; ``fn`` (when given) re-binds the
+        callback — latest registration wins, so a recreated owner (a
+        new scheduler over the shared registry) takes over its gauges
+        instead of leaving stale closures behind."""
+        with self._lock:
+            g = self._gauges.setdefault(name, Gauge())
+            if labels:
+                fam = self._gauge_children.setdefault(name, {})
+                key = _labels_key(labels)
+                g = fam.setdefault(key, Gauge())
+        if fn is not None:
+            g.fn = fn
+        return g
+
+    def gauge_value(self, name: str, labels: Optional[dict] = None
+                    ) -> float:
+        """A labeled gauge's read, or the parent's — a parent with no
+        callback of its own reads as the SUM of its children (the
+        roll-up contract, mirrored from counters)."""
+        with self._lock:
+            g = self._gauges.get(name)
+            fam = dict(self._gauge_children.get(name) or {})
+        if labels is not None:
+            c = fam.get(_labels_key(labels))
+            return c.read() if c is not None else 0.0
+        if g is None:
+            return 0.0
+        if g.fn is None and fam:
+            return sum(c.read() for c in fam.values())
+        return g.read()
+
+    def counter_value(self, name: str,
+                      labels: Optional[dict] = None) -> int:
+        """Parent count, or (with ``labels``) the sum over children
+        whose label sets CONTAIN every given pair — so
+        ``counter_value("serving.jobs.completed", {"tenant": "a"})``
+        aggregates tenant ``a`` across its per-kind children."""
+        if labels:
+            return sum(c.count
+                       for _lbls, c in self.children(name, labels))
         c = self._counters.get(name)
         return c.count if c is not None else 0
+
+    def children(self, name: str, match: Optional[dict] = None) -> list:
+        """(labels, child-handle) pairs for a metric name, optionally
+        filtered to label sets containing every pair of ``match``."""
+        with self._lock:
+            fam = list((self._children.get(name) or {}).values())
+        if match:
+            want = {(str(k), str(v)) for k, v in match.items()}
+            fam = [p for p in fam if want <= set(p.labels.items())]
+        return [(dict(p.labels), p) for p in fam]
+
+    def labeled(self) -> dict:
+        """Every labeled child's stats, keyed by parent name — the
+        dimensional companion of ``snapshot()`` (which stays
+        byte-identical to its pre-label schema): ``{name: [(labels,
+        {"type": ..., ...stats}), ...]}`` sorted by name and label
+        set."""
+        with self._lock:
+            fams = {n: dict(f) for n, f in self._children.items() if f}
+        out: dict = {}
+        for name in sorted(fams):
+            out[name] = [(dict(k), fams[name][k].stats())
+                         for k in sorted(fams[name])]
+        return out
+
+    def gauge_counters(self) -> set:
+        """Names of counters flagged ``gauge=True`` (bidirectional) —
+        the exposition types these as gauges."""
+        with self._lock:
+            return {n for n, c in self._counters.items() if c.gauge}
+
+    def gauge_snapshot(self) -> dict:
+        """``{name: {"value": parent read, "own": bool, "children":
+        [(labels, value)]}}`` — gauges are views, not accumulations, so
+        they live outside ``snapshot()``. ``own`` marks a parent with
+        its OWN callback: when False and children exist, ``value`` is
+        the sum-of-children roll-up — fine for additive families (HBM
+        bytes) but meaningless for ratios (burn rates), so the
+        Prometheus exposition only emits the parent sample when it is
+        ``own`` or childless."""
+        with self._lock:
+            names = sorted(set(self._gauges) | set(self._gauge_children))
+            fams = {n: dict(self._gauge_children.get(n) or {})
+                    for n in names}
+            parents = {n: self._gauges.get(n) for n in names}
+        out: dict = {}
+        for n in names:
+            # each callback runs ONCE per scrape: the children reads
+            # feed both the child samples and (for a callback-less
+            # parent) the roll-up sum
+            kids = [(dict(k), fams[n][k].read()) for k in sorted(fams[n])]
+            p = parents[n]
+            own = p is not None and p.fn is not None
+            if own or not kids:
+                value = p.read() if p is not None else 0.0
+            else:
+                value = sum(v for _k, v in kids)
+            out[n] = {"value": value, "own": own, "children": kids}
+        return out
 
     def timer_count(self, name: str) -> int:
         t = self._timers.get(name)
@@ -223,6 +524,9 @@ class MetricManager:
             self._counters.clear()
             self._timers.clear()
             self._histograms.clear()
+            self._children.clear()
+            self._gauges.clear()
+            self._gauge_children.clear()
 
     # -- reporters (reference: console/CSV reporters,
     #    GraphDatabaseConfiguration.java:1010-1226) --------------------------
