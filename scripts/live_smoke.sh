@@ -7,9 +7,12 @@
 # rebuild on the serving path; (2) BIT-EQUALITY — the final job's full
 # distance array matches a post-hoc rebuilt snapshot; (3) the
 # serving.live.* surface (feed batches, overlay fill, epochs) is
-# observable end-to-end over the wire.
+# observable end-to-end over the wire; (4) ISSUE 9 — epochs under the
+# writer flood fold ON DEVICE and the per-epoch H2D upload bytes stay
+# bounded by delta pages (>= 10x below the full snapshot image the host
+# path would re-ship each epoch).
 #
-# Usage: scripts/live_smoke.sh   (CPU-safe; ~40s incl. XLA compiles)
+# Usage: scripts/live_smoke.sh   (CPU-safe; ~60s incl. XLA compiles)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -36,19 +39,31 @@ shared = tempfile.mkdtemp(prefix="live_smoke_") + "/db"
 g = titan_tpu.open({"storage.backend": "sqlite",
                     "storage.directory": shared,
                     "graph.unique-instance-id": "server"})
+# a base big enough that the full CSR image dwarfs the writer flood's
+# delta pages — the ISSUE 9 byte-ratio assertion needs the contrast
+NV = 256
 tx = g.new_transaction()
-vs = [tx.add_vertex("node", name=f"v{i:02d}") for i in range(12)]
-for a, b in [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5), (5, 6), (6, 7)]:
-    vs[a].add_edge("link", vs[b])
+vs = [tx.add_vertex("node", name=f"v{i:03d}") for i in range(NV)]
+for a in range(NV - 1):
+    vs[a].add_edge("link", vs[a + 1])
 tx.commit()
 tx = g.new_transaction()
 ids = sorted(v.id for v in tx.vertices())
 tx.rollback()
 
-plane = LiveGraphPlane(g, log_identifier="live", poll_interval_s=0.05)
+# small overlay bucket + aggressive fill threshold: the 15-commit flood
+# crosses several epoch boundaries, all folded on device
+plane = LiveGraphPlane(g, log_identifier="live", poll_interval_s=0.05,
+                       min_cap=64, max_fill=0.1)
 sched = JobScheduler(live=plane)
 srv = GraphServer(g, port=0, scheduler=sched).start()
 print(f"live_smoke: server on {srv.host}:{srv.port}, store {shared}")
+
+# the serving path would upload the base image on the first job; do it
+# eagerly so every epoch boundary sees a device-resident base CSR
+from titan_tpu.models.bfs_hybrid import build_chunked_csr
+from titan_tpu.olap.serving.hbm import snapshot_csr_bytes
+build_chunked_csr(plane.snapshot)
 
 
 def req(path, payload=None, method="GET"):
@@ -70,7 +85,8 @@ g = titan_tpu.open({{"storage.backend": "sqlite",
 ids = {ids!r}
 for i in range(15):
     tx = g.new_transaction(log_identifier="live")
-    tx.vertex(ids[i % 12]).add_edge("link", tx.vertex(ids[(i + 5) % 12]))
+    tx.vertex(ids[i % len(ids)]).add_edge(
+        "link", tx.vertex(ids[(i + 5) % len(ids)]))
     tx.commit()
     time.sleep(0.05)
 g.close()
@@ -102,6 +118,26 @@ else:
 print("live_smoke: freshness lag drained:", json.dumps(lag),
       "| overlay:", json.dumps(live["overlay"]))
 assert live["counters"]["feed_batches"] >= 15
+
+# ---- ISSUE 9: device-merged epochs, bounded per-epoch upload bytes --
+comp = live["compactor"]
+counters = live["counters"]
+epochs = max(live["epoch"], 1)
+full_bytes = snapshot_csr_bytes(plane.snapshot)
+up = counters["upload_bytes"]
+print(f"live_smoke: {live['epoch']} epochs, merge_mode="
+      f"{comp['merge_mode']}, device_merges={comp['device_merges']}, "
+      f"fallbacks={comp['fallbacks']}, upload_bytes={up}, "
+      f"full_image_bytes={full_bytes} "
+      f"({full_bytes / max(up, 1):.0f}x headroom)")
+assert comp["device_merges"] >= 1, comp
+assert comp["merge_mode"] == "device", comp
+assert counters["device_merge_fallbacks"] == 0, comp
+# delta pages << full snapshot image: ALL the flood's epochs together
+# must ship at least 10x fewer H2D bytes than ONE host-path re-upload
+# (the host path would have paid full_bytes PER epoch)
+assert 0 < up * 10 <= full_bytes, (up, full_bytes, epochs)
+assert counters["download_bytes"] == 0, counters
 
 # ---- bit-equality vs a post-hoc rebuilt snapshot --------------------
 job = req("/jobs", {"kind": "bfs", "source": ids[0]}, method="POST")

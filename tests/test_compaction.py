@@ -188,8 +188,10 @@ def test_round_loop_modules_are_nonzero_free():
     code under olap/serving/ — must use the compaction primitives too;
     (ISSUE r8) to olap/recovery/, whose checkpoint callbacks run
     INSIDE the round loops; (ISSUE r9) to olap/live/, whose
-    overlay views feed per-round expansion passes; and (ISSUE r10) to
-    obs/, whose tracing hooks run at every round boundary."""
+    overlay views feed per-round expansion passes; (ISSUE r10) to
+    obs/, whose tracing hooks run at every round boundary; and
+    (ISSUE 9) to ops/epoch_merge, the device epoch-merge kernel —
+    every survivor compaction there must go through ops.compaction."""
     import importlib
     import inspect
     import io
@@ -201,6 +203,7 @@ def test_round_loop_modules_are_nonzero_free():
     import titan_tpu.olap.recovery as recovery_pkg
     import titan_tpu.olap.serving as serving_pkg
     from titan_tpu.models import bfs_hybrid, bfs_hybrid_sharded, frontier
+    from titan_tpu.ops import epoch_merge
 
     serving_mods = [
         importlib.import_module(f"titan_tpu.olap.serving.{m.name}")
@@ -220,7 +223,7 @@ def test_round_loop_modules_are_nonzero_free():
         for m in pkgutil.iter_modules(obs_pkg.__path__)]
     assert len(obs_mods) >= 3       # tracing/promexport + slo (ISSUE 8)
 
-    for mod in (frontier, bfs_hybrid, bfs_hybrid_sharded,
+    for mod in (frontier, bfs_hybrid, bfs_hybrid_sharded, epoch_merge,
                 *serving_mods, *recovery_mods, *live_mods, *obs_mods):
         src = inspect.getsource(mod)
         calls = [

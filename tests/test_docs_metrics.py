@@ -87,6 +87,14 @@ def test_every_code_metric_documented_and_vice_versa():
                    "serving.recovery.", "serving.tenant.",
                    "serving.slo.", "serving.hbm.", "serving.pool."):
         assert any(n.startswith(family) for n in code), (family, code)
+    # ISSUE 9: the epoch-compaction byte/fallback surface must stay in
+    # the scan (created in overlay/compactor AND via the _LIVE_COUNTERS
+    # template the plane iterates)
+    for name in ("serving.live.upload_bytes",
+                 "serving.live.download_bytes",
+                 "serving.live.device_merge_fallbacks",
+                 "serving.live.compact_device_ms"):
+        assert name in code, name
     missing_from_docs = code - docs
     assert not missing_from_docs, (
         "metric names created in code but absent from a "

@@ -19,6 +19,17 @@ the delta next to them:
   the touched bytes, so a removal costs O(changed bytes) H2D, not a
   re-upload.
 
+Delta-page uploads (ISSUE 9): ``view()`` ships only the CHANGED device
+bytes — the appended row range (plus any in-place-killed rows) is
+scattered into the resident add buffers, and only the dirtied tombstone
+bytes hit the bitmap. Buffer establishment and capacity growth are
+device-side pad fills (``jnp.full`` / pad-extension), so they cost no
+H2D at all. Every byte that does cross the tunnel — scatter payloads
+AND the int32 index words the scatters ship — is counted on
+``serving.live.upload_bytes`` when a ``metrics`` manager is attached,
+so the H2D cost of freshness is directly observable
+(docs/monitoring.md, the ``live_refresh`` bench stage).
+
 Views are immutable: :meth:`view` freezes the current device arrays +
 counters into an :class:`OverlayView`; a running job keeps reading its
 leased view while the plane appends to fresh arrays (jax arrays are
@@ -80,7 +91,7 @@ class DeltaOverlay:
     compactor folds it into the base and starts a fresh overlay."""
 
     def __init__(self, snapshot, *, min_cap: int = MIN_CAP,
-                 ledger=None, ledger_key=None):
+                 ledger=None, ledger_key=None, metrics=None):
         self.snap = snapshot
         self.n = int(snapshot.n)
         deg = snapshot.out_degree.astype(np.int64)
@@ -109,13 +120,20 @@ class DeltaOverlay:
         self.tomb_row_mask = np.zeros(snapshot.num_edges, bool)
         self.tomb_count = 0
         self.seq = 0                   # bumps on every mutation
-        # device state
+        # device state: rows [0, _clean_rows) of the add buffers are
+        # already device-resident and accurate; rows the writer killed
+        # IN PLACE below that watermark collect in _dirty_add_rows.
+        # view() scatters only (watermark tail + dirty rows) — the
+        # delta pages; buffer establishment and capacity growth are
+        # device-side pad fills (jnp.full / concatenate), so they cost
+        # ZERO H2D — only changed rows/bytes ever cross the tunnel.
         self._d_src = None
         self._d_dst = None
         self._d_tomb = None
-        self._dirty_adds = True
+        self._clean_rows = 0
+        self._dirty_add_rows: set = set()
         self._dirty_tomb_bytes: set = set()
-        self._tomb_fresh = False
+        self._metrics = metrics
         self._ledger = ledger
         self._ledger_key = ledger_key if ledger_key is not None \
             else ("live-overlay", id(self))
@@ -154,8 +172,7 @@ class DeltaOverlay:
             fresh = np.full(new_cap, fill, np.int32)
             fresh[:self.count] = old[:self.count]
             setattr(self, name, fresh)
-        self.cap = new_cap
-        self._dirty_adds = True
+        self.cap = new_cap    # device buffers pad-extend at next view()
         self._reserve()       # raises AdmissionError when HBM is tight
                               # — the plane responds by compacting
 
@@ -174,9 +191,8 @@ class DeltaOverlay:
         self._h_src[sl] = src_dense
         self._h_dst[sl] = dst_dense
         self._h_lab[sl] = labs
-        self.count += k
-        self._dirty_adds = True
-        self.seq += 1
+        self.count += k          # the [_clean_rows, count) tail is the
+        self.seq += 1            # delta page view() scatters — no flag
         return k
 
     def _labels_src_order(self) -> Optional[np.ndarray]:
@@ -224,7 +240,8 @@ class DeltaOverlay:
                 self._h_src[i] = self.n + 1
                 self._h_dst[i] = self.n + 1
                 self.dead_adds += 1
-                self._dirty_adds = True
+                if i < self._clean_rows:
+                    self._dirty_add_rows.add(i)
                 self.seq += 1
                 return True
         return False
@@ -256,29 +273,63 @@ class DeltaOverlay:
 
     # -- device sync / views -------------------------------------------------
 
+    def _count_upload(self, nbytes: int) -> None:
+        if self._metrics is not None and nbytes:
+            self._metrics.counter("serving.live.upload_bytes") \
+                .inc(int(nbytes))
+
     def view(self) -> OverlayView:
         """Freeze the current state into an immutable device view.
-        Add-buffer uploads are cap-sized (small — the delta); tombstone
-        updates scatter only the dirtied bytes into the device bitmap."""
+        ONLY delta pages cross the tunnel: the appended tail (plus any
+        in-place-killed rows) scatters into the resident add buffers,
+        and only dirtied bytes hit the tombstone bitmap. Buffer
+        establishment and capacity growth are device-side pad fills —
+        never an upload. Every byte that does ship counts on
+        ``serving.live.upload_bytes``."""
         import jax.numpy as jnp
 
-        if self._dirty_adds or self._d_src is None \
-                or self._d_src.shape[0] != self.cap:
-            # .copy(): the CPU backend zero-copies numpy buffers into
-            # device arrays — an aliased upload would let later host
-            # appends mutate FROZEN views
-            self._d_src = jnp.asarray(self._h_src.copy())
-            self._d_dst = jnp.asarray(self._h_dst.copy())
-            self._dirty_adds = False
+        pad = jnp.int32(self.n + 1)
+        if self._d_src is None:
+            # device-side constant fill: 0 bytes H2D; the scatter
+            # below ships rows [0, count) — the actual delta
+            self._d_src = jnp.full((self.cap,), pad, jnp.int32)
+            self._d_dst = jnp.full((self.cap,), pad, jnp.int32)
+            self._clean_rows = 0
+        elif self._d_src.shape[0] != self.cap:
+            # capacity bucket grew: pad-extend ON DEVICE (device-to-
+            # device copy, 0 bytes H2D); resident rows stay valid —
+            # in-place kills are tracked in _dirty_add_rows
+            ext = jnp.full((self.cap - self._d_src.shape[0],), pad,
+                           jnp.int32)
+            self._d_src = jnp.concatenate([self._d_src, ext])
+            self._d_dst = jnp.concatenate([self._d_dst, ext])
+        if self._dirty_add_rows or self._clean_rows < self.count:
+            rows = sorted(self._dirty_add_rows)
+            rows.extend(range(self._clean_rows, self.count))
+            idx = jnp.asarray(np.asarray(rows, np.int32))
+            # .at[].set returns NEW arrays — frozen views keep theirs
+            self._d_src = self._d_src.at[idx].set(
+                jnp.asarray(self._h_src[rows]))
+            self._d_dst = self._d_dst.at[idx].set(
+                jnp.asarray(self._h_dst[rows]))
+            self._clean_rows = self.count
+            self._dirty_add_rows.clear()
+            # 2 int32 payloads + the int32 scatter-index array (shipped
+            # once, reused by both scatters) — index words are H2D too
+            self._count_upload((2 * 4 + 4) * len(rows))
         if self._d_tomb is None:
-            self._d_tomb = jnp.asarray(self._h_tomb.copy())
-            self._dirty_tomb_bytes.clear()
-        elif self._dirty_tomb_bytes:
+            # all-zero bitmap: device-side fill, 0 bytes H2D (every
+            # set byte since construction is in _dirty_tomb_bytes)
+            self._d_tomb = jnp.zeros((self.q_total,), jnp.uint8)
+        if self._dirty_tomb_bytes:
             idx = np.fromiter(self._dirty_tomb_bytes, np.int64,
                               len(self._dirty_tomb_bytes))
-            self._d_tomb = self._d_tomb.at[jnp.asarray(idx)].set(
+            self._d_tomb = self._d_tomb.at[
+                jnp.asarray(idx.astype(np.int32))].set(
                 jnp.asarray(self._h_tomb[idx]))
             self._dirty_tomb_bytes.clear()
+            # 1 payload byte + 4 index bytes per dirtied bitmap byte
+            self._count_upload(5 * len(idx))
         return OverlayView(self.n, self.cap, self.count, self._d_src,
                            self._d_dst, self._d_tomb, self.tomb_count,
                            self.seq, slot_base=self.q_total * 8)

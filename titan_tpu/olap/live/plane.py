@@ -27,9 +27,11 @@ store re-scan (``resync``) that re-anchors the change queue.
 
 Metrics (``serving.live.*`` — see docs/monitoring.md): deltas_applied,
 edges_added, edges_tombstoned, compactions, resyncs, feed_batches,
-backpressure counters; apply_ms / compact_ms histograms; freshness lag
-(epochs + seconds), overlay fill and tombstone fraction via
-``stats()`` → ``GET /live``.
+backpressure, upload_bytes, download_bytes, device_merge_fallbacks
+counters; apply_ms / compact_ms / compact_device_ms histograms;
+freshness lag (epochs + seconds), overlay fill and tombstone fraction,
+and the active compaction policy + merge mode via ``stats()`` →
+``GET /live``.
 """
 
 from __future__ import annotations
@@ -48,10 +50,14 @@ from titan_tpu.utils.metrics import MetricManager
 
 #: the plane's ``serving.live.*`` counter family — ONE definition
 #: shared by stats() and the metric-name doc-drift guard
-#: (tests/test_docs_metrics.py)
+#: (tests/test_docs_metrics.py). upload_bytes / download_bytes /
+#: device_merge_fallbacks are the ISSUE 9 byte-accounting surface:
+#: delta pages + host-merge re-upload charges, verify-mode readback,
+#: and loud device→host degrades.
 _LIVE_COUNTERS = ("deltas_applied", "edges_added", "edges_tombstoned",
                   "compactions", "resyncs", "feed_batches",
-                  "backpressure")
+                  "backpressure", "upload_bytes", "download_bytes",
+                  "device_merge_fallbacks")
 
 
 class LiveGraphPlane:
@@ -65,9 +71,15 @@ class LiveGraphPlane:
                  reader_id: Optional[str] = None,
                  min_cap: int = MIN_CAP,
                  compactor: Optional[EpochCompactor] = None,
+                 max_fill: Optional[float] = None,
+                 max_tomb_fraction: Optional[float] = None,
+                 device_merge: bool = True,
+                 verify_device: bool = False,
                  ledger=None,
                  metrics: Optional[MetricManager] = None,
                  poll_interval_s: Optional[float] = None):
+        from titan_tpu.olap.live.compactor import (MAX_FILL,
+                                                   MAX_TOMB_FRACTION)
         from titan_tpu.olap.tpu import snapshot as snap_mod
 
         self.graph = graph
@@ -78,10 +90,20 @@ class LiveGraphPlane:
         # ledger) so apply/compaction epochs land on the reserved
         # "live" trace id; None = no tracing
         self._tracer = None
+        # serving seam: the owning JobScheduler registers published
+        # epochs in its HBM eviction map through this hook
+        self._on_resident = None
         self._lock = threading.RLock()
         self._min_cap = int(min_cap)
         self._ledger = ledger
-        self.compactor = compactor or EpochCompactor()
+        # compaction policy is plane/server configuration (ISSUE 9
+        # satellite), not module constants: pass a prebuilt compactor
+        # OR the individual knobs
+        self.compactor = compactor or EpochCompactor(
+            max_fill if max_fill is not None else MAX_FILL,
+            max_tomb_fraction if max_tomb_fraction is not None
+            else MAX_TOMB_FRACTION,
+            device_merge=device_merge, verify_device=verify_device)
 
         # the feed starts BEFORE the build scan and the ingest floor is
         # stamped before it too: a remote commit racing the scan is
@@ -131,7 +153,8 @@ class LiveGraphPlane:
     def _new_overlay(self, snap) -> DeltaOverlay:
         return DeltaOverlay(snap, min_cap=self._min_cap,
                             ledger=self._ledger,
-                            ledger_key=("live-overlay", id(self)))
+                            ledger_key=("live-overlay", id(self)),
+                            metrics=self._metrics)
 
     @property
     def pool_key(self) -> tuple:
@@ -382,7 +405,15 @@ class LiveGraphPlane:
 
     def _compact(self, extra_payloads: list, why: str = "") -> None:
         t0 = time.time()
-        merged = self.compactor.merge(self.snapshot, self.overlay)
+        # device merge by default: next epoch's CSR is computed in HBM
+        # beside the current one (double-buffered through the ledger)
+        # and published pre-attached — no serving gap, no re-upload.
+        # Payloads the overlay can't express force the host path (their
+        # apply_changes invalidates device caches anyway).
+        merged, mode = self.compactor.compact(
+            self.snapshot, self.overlay, ledger=self._ledger,
+            metrics=self._metrics, host_only=bool(extra_payloads),
+            on_resident=self._on_resident)
         if extra_payloads:
             merged.apply_changes(extra_payloads, self.graph.schema,
                                  self.graph.idm)
@@ -392,7 +423,7 @@ class LiveGraphPlane:
             (time.time() - t0) * 1e3)
         if self._tracer is not None:
             self._tracer.event("live", "compact", t0=t0, why=why,
-                               epoch=self.epoch)
+                               mode=mode, epoch=self.epoch)
 
     def compact_if_dirty(self) -> bool:
         """Force-fold the overlay (dense/PageRank's documented
@@ -460,6 +491,9 @@ class LiveGraphPlane:
                     "feed_pending": feed_pending,
                 },
                 "overlay": self.overlay.stats(),
+                # active thresholds + merge mode (device/host) +
+                # fallback reasons — the ISSUE 9 GET /live surface
+                "compactor": self.compactor.policy(),
                 "counters": {
                     k: m.counter_value(f"serving.live.{k}")
                     for k in _LIVE_COUNTERS},
@@ -467,4 +501,7 @@ class LiveGraphPlane:
                              .to_dict(),
                 "compact_ms": m.histogram("serving.live.compact_ms")
                                .to_dict(),
+                "compact_device_ms":
+                    m.histogram("serving.live.compact_device_ms")
+                     .to_dict(),
             }

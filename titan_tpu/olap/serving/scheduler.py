@@ -121,6 +121,11 @@ class JobScheduler:
         # admission-controlled
         self.live = live
         self.pool = SnapshotPool(graph, snapshot, live=live)
+        # the evictable map must exist BEFORE the ledger (whose
+        # on_evict callback reads it) and before the live plane's
+        # hooks: the plane's pump thread is already running and can
+        # fire a device-merged compaction mid-__init__
+        self._evictable: dict = {}    # ledger key -> snapshot (cache drop)
         self.ledger = HBMLedger(hbm_budget_bytes, on_evict=self._evict)
         if live is not None and live._ledger is None:
             live._ledger = self.ledger
@@ -128,6 +133,13 @@ class JobScheduler:
             # the plane records apply/compaction epochs under the
             # reserved "live" trace id (GET /trace?job=live)
             live._tracer = self.tracer
+        if live is not None:
+            # device-merged epochs arrive ledger-resident with their
+            # CSR pre-attached (no upload); register them in the
+            # eviction map so an HBM eviction of the unpinned epoch
+            # actually drops the device arrays
+            live._on_resident = (
+                lambda snap: self._evictable.setdefault(id(snap), snap))
         self.batcher = Batcher(max_batch=max_batch)
         self.max_batch = max_batch
         self._metrics = metrics or MetricManager.instance()
@@ -182,7 +194,6 @@ class JobScheduler:
         self._cv = threading.Condition()
         self._stop = False
         self._running_batch = 0
-        self._evictable: dict = {}    # ledger key -> snapshot (cache drop)
         # retired/closed snapshots must not stay ledger-resident
         self.pool.on_close = self._forget_snapshot
         self._worker: Optional[threading.Thread] = None

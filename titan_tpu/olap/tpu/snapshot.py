@@ -453,6 +453,56 @@ def from_arrays(n: int, src, dst, vertex_ids=None, edge_values=None,
                          dict(label_names or {}))
 
 
+def merge_delta(snap: GraphSnapshot, keep: np.ndarray, add_src,
+                add_dst, add_labels=None) -> GraphSnapshot:
+    """Incremental dst-sorted merge: drop the rows where ``keep`` is
+    False and insert the added edges, WITHOUT re-sorting the surviving
+    rows — bit-equal to ``from_arrays(n, concat(src[keep], add_src),
+    concat(dst[keep], add_dst), ...)`` (the full stable sort both the
+    native and numpy builders run), because the kept rows stay
+    dst-ascending and a stable dst-sort puts equal-dst adds AFTER the
+    kept rows in append order, which is exactly a ``side='right'``
+    searchsorted insert. O(E) memcpy + O(delta log delta), no O(E log
+    E) sort — the epoch compactor's host-durable sync
+    (olap/live/compactor.py device merge path) runs this every epoch.
+    """
+    add_src = np.asarray(add_src, np.int32)
+    add_dst = np.asarray(add_dst, np.int32)
+    if len(add_src) and (int(add_src.min()) < 0
+                        or int(add_src.max()) >= snap.n
+                        or int(add_dst.min()) < 0
+                        or int(add_dst.max()) >= snap.n):
+        raise IndexError(f"edge endpoint out of range [0, {snap.n})")
+    order = np.argsort(add_dst, kind="stable")
+    a_s, a_d = add_src[order], add_dst[order]
+    dst_kept = snap.dst[keep]
+    pos = np.searchsorted(dst_kept, a_d, side="right")
+    src = np.insert(snap.src[keep], pos, a_s)
+    dst = np.insert(dst_kept, pos, a_d)
+    labels = None
+    if snap.labels is not None:
+        a_l = np.asarray(add_labels, np.int32)[order] \
+            if add_labels is not None \
+            else np.zeros(len(a_s), np.int32)
+        labels = np.insert(snap.labels[keep], pos, a_l)
+    counts = np.diff(snap.indptr_in)
+    dead_dst = snap.dst[~keep].astype(np.int64)
+    if len(dead_dst):
+        np.add.at(counts, dead_dst, -1)
+    if len(a_d):
+        np.add.at(counts, a_d.astype(np.int64), 1)
+    indptr_in = np.concatenate(
+        [np.zeros(1, np.int64), np.cumsum(counts, dtype=np.int64)])
+    out_degree = snap.out_degree.copy()
+    dead_src = snap.src[~keep].astype(np.int64)
+    if len(dead_src):
+        np.add.at(out_degree, dead_src, -1)
+    if len(a_s):
+        np.add.at(out_degree, a_s.astype(np.int64), 1)
+    return GraphSnapshot(snap.n, snap.vertex_ids, src, dst, indptr_in,
+                         out_degree, {}, labels, dict(snap.label_names))
+
+
 def _scan_python(graph, rows, exists_q, scan_q, label_ids, key_ids):
     """Per-entry decode via the Python codec (fallback; also the path when
     edge property values must be extracted)."""
