@@ -1,0 +1,127 @@
+#!/usr/bin/env bash
+# Postmortem-plane smoke (ISSUE 10): stand up the HTTP server with a
+# flight recorder attached, kill a mid-flight BFS job over HTTP
+# (DELETE /jobs/<id> while RUNNING), and verify the abnormal end wrote
+# a self-contained, parseable dump bundle — terminal span present,
+# >= 1 per-round record for the killed job, device events non-empty —
+# referenced from GET /jobs/<id> and listed by GET /debug/dumps.
+# Also exercises POST /debug/dump (on-demand capture).
+# The in-CI twin lives in tests/test_flightrec.py; this script proves
+# the out-of-process surface end to end.
+#
+# Usage: scripts/postmortem_smoke.sh   (CPU-safe; ~30s incl. XLA compiles)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python - <<'EOF'
+import json
+import tempfile
+import time
+import urllib.request
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import titan_tpu
+from titan_tpu.olap.serving.scheduler import JobScheduler
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.server import GraphServer
+from titan_tpu.utils.metrics import MetricManager
+
+def req(srv, path, payload=None, method="GET"):
+    r = urllib.request.Request(
+        f"http://{srv.host}:{srv.port}{path}",
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"}, method=method)
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        return json.loads(resp.read())
+
+# a path graph: one BFS level per vertex, so the job stays mid-flight
+# long enough to be killed at a round boundary
+n = 4096
+es = np.arange(n - 1, dtype=np.int32)
+ed = es + 1
+snap = snap_mod.from_arrays(n, np.concatenate([es, ed]),
+                            np.concatenate([ed, es]))
+dump_dir = tempfile.mkdtemp(prefix="titan-postmortem-smoke-")
+g = titan_tpu.open("inmemory")
+sched = JobScheduler(snapshot=snap, metrics=MetricManager(),
+                     flight_dir=dump_dir)
+srv = GraphServer(g, port=0, scheduler=sched).start()
+print(f"postmortem_smoke: server up at {srv.host}:{srv.port}, "
+      f"dumps under {dump_dir}")
+
+hz = req(srv, "/healthz")
+assert hz["live"] and hz["ready"], hz
+print(f"postmortem_smoke: /healthz ready (checks: {hz['checks']})  OK")
+
+# 1. submit, wait until it is RUNNING with >= 2 recorded rounds, then
+#    kill it over HTTP
+job_id = req(srv, "/jobs", {"kind": "bfs", "source_dense": 0},
+             method="POST")["job"]
+deadline = time.time() + 60
+while time.time() < deadline:
+    j = req(srv, f"/jobs/{job_id}")
+    rounds = (j.get("trace") or {}).get("rounds") or 0
+    if j["status"] == "running" and rounds >= 2:
+        break
+    assert j["status"] in ("queued", "running"), \
+        f"job finished before the kill: {j['status']}"
+    time.sleep(0.01)
+else:
+    raise AssertionError("job never reached RUNNING with 2 rounds")
+req(srv, f"/jobs/{job_id}", method="DELETE")
+deadline = time.time() + 60
+while time.time() < deadline:
+    j = req(srv, f"/jobs/{job_id}")
+    if j["status"] not in ("queued", "running"):
+        break
+    time.sleep(0.02)
+assert j["status"] == "cancelled", f"expected cancelled, got {j}"
+print(f"postmortem_smoke: killed mid-flight after "
+      f"{j['trace']['rounds']} rounds -> {j['status']}  OK")
+
+# 2. the abnormal end must have written a bundle, referenced from the
+#    job envelope (the dump lands just after the terminal transition)
+deadline = time.time() + 10
+while time.time() < deadline:
+    j = req(srv, f"/jobs/{job_id}")
+    if j.get("postmortem"):
+        break
+    time.sleep(0.02)
+path = j.get("postmortem")
+assert path, f"no postmortem reference in GET /jobs/{job_id}: {j}"
+bundle = json.load(open(path))          # parseable, self-contained
+assert bundle["format"] == "titan-tpu-postmortem-v1", bundle["format"]
+assert bundle["reason"] == "cancelled"
+names = []
+def walk(node):
+    names.append(node["name"])
+    for c in node["children"]:
+        walk(c)
+for root in bundle["span_tree"]["spans"]:
+    walk(root)
+assert "cancelled" in names, f"terminal span missing: {names}"
+assert len(bundle["rounds"]) >= 1, "no round records in the bundle"
+assert all(r["trace"] == job_id for r in bundle["rounds"])
+assert bundle["device_events"], "device-event section is empty"
+print(f"postmortem_smoke: bundle {path.rsplit('/', 1)[-1]} parseable "
+      f"(terminal span + {len(bundle['rounds'])} rounds + "
+      f"{len(bundle['device_events'])} device events)  OK")
+
+# 3. GET /debug/dumps lists it; POST /debug/dump captures on demand
+idx = req(srv, "/debug/dumps")
+assert idx["enabled"] and any(d["path"] == path for d in idx["dumps"]), idx
+manual = req(srv, "/debug/dump", {"job": job_id}, method="POST")
+idx2 = req(srv, "/debug/dumps")
+assert any(d["path"] == manual["path"] for d in idx2["dumps"])
+assert len(idx2["dumps"]) == len(idx["dumps"]) + 1
+print(f"postmortem_smoke: /debug/dumps lists {len(idx2['dumps'])} "
+      f"bundles (incl. on-demand {manual['file']})  OK")
+
+srv.stop()
+sched.close()
+g.close()
+print("postmortem_smoke: PASS")
+EOF

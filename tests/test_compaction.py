@@ -189,9 +189,11 @@ def test_round_loop_modules_are_nonzero_free():
     (ISSUE r8) to olap/recovery/, whose checkpoint callbacks run
     INSIDE the round loops; (ISSUE r9) to olap/live/, whose
     overlay views feed per-round expansion passes; (ISSUE r10) to
-    obs/, whose tracing hooks run at every round boundary; and
-    (ISSUE 9) to ops/epoch_merge, the device epoch-merge kernel —
-    every survivor compaction there must go through ops.compaction."""
+    obs/, whose tracing hooks run at every round boundary — since
+    ISSUE 10 that includes devprof/flightrec, whose profiler shims and
+    ring taps wrap every kernel dispatch; and (ISSUE 9) to
+    ops/epoch_merge, the device epoch-merge kernel — every survivor
+    compaction there must go through ops.compaction."""
     import importlib
     import inspect
     import io
@@ -221,7 +223,8 @@ def test_round_loop_modules_are_nonzero_free():
     obs_mods = [
         importlib.import_module(f"titan_tpu.obs.{m.name}")
         for m in pkgutil.iter_modules(obs_pkg.__path__)]
-    assert len(obs_mods) >= 3       # tracing/promexport + slo (ISSUE 8)
+    # tracing/promexport + slo (ISSUE 8) + devprof/flightrec (ISSUE 10)
+    assert len(obs_mods) >= 5
 
     for mod in (frontier, bfs_hybrid, bfs_hybrid_sharded, epoch_merge,
                 *serving_mods, *recovery_mods, *live_mods, *obs_mods):

@@ -1,9 +1,10 @@
 """Metric-name doc-drift guard (ISSUE r10 satellite).
 
-Every ``serving.*`` / ``serving.live.*`` / ``serving.recovery.*``
-metric name created in code must appear in a docs/monitoring.md table,
-and every name documented there must exist in code — so the tables
-stop rotting as planes grow.
+Every ``serving.*`` / ``serving.live.*`` / ``serving.recovery.*`` —
+and, since ISSUE 10, ``device.*`` / ``flightrec.*`` — metric name
+created in code must appear in a docs/monitoring.md table, and every
+name documented there must exist in code — so the tables stop rotting
+as planes grow.
 
 The code scan finds quoted metric-name literals (all real names have
 >= 3 dot components, which screens out prefix constants like
@@ -20,18 +21,23 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _PKG = os.path.join(_REPO, "titan_tpu")
 _DOC = os.path.join(_REPO, "docs", "monitoring.md")
 
-# quoted literal with >= 3 dot-components under serving.*; {x} keeps
-# f-string placeholders visible for template expansion
+# quoted literal with >= 3 dot-components under a guarded family
+# prefix; {x} keeps f-string placeholders visible for template
+# expansion (device./flightrec. joined serving. in ISSUE 10)
+_FAMILIES = r"(?:serving|device|flightrec)"
 _LITERAL = re.compile(
-    r"""["']f?(serving\.[a-z0-9_]+\.[a-z0-9_.{}]+)["']""")
+    r"""["']f?(""" + _FAMILIES
+    + r"""\.[a-z0-9_]+\.[a-z0-9_.{}]+)["']""")
 _FSTRING = re.compile(
-    r"""f["'](serving\.[a-z0-9_]+\.[a-z0-9_.{}]+)["']""")
+    r"""f["'](""" + _FAMILIES
+    + r"""\.[a-z0-9_]+\.[a-z0-9_.{}]+)["']""")
 # names recovery/store.py builds off its configurable prefix (default
 # "serving.recovery")
 _PREFIXED = re.compile(r"""f["']\{self\._prefix\}\.([a-z0-9_]+)["']""")
 # a table row's first column: | `serving.x.y` | ... |
-_DOC_ROW = re.compile(r"^\|\s*`(serving\.[a-z0-9_.]+)`\s*\|",
-                      re.MULTILINE)
+_DOC_ROW = re.compile(
+    r"^\|\s*`(" + _FAMILIES + r"\.[a-z0-9_.]+)`\s*\|",
+    re.MULTILINE)
 
 
 def _code_metric_names() -> set:
@@ -85,8 +91,17 @@ def test_every_code_metric_documented_and_vice_versa():
     # the guard to the tenant/SLO/gauge names)
     for family in ("serving.jobs.", "serving.live.",
                    "serving.recovery.", "serving.tenant.",
-                   "serving.slo.", "serving.hbm.", "serving.pool."):
+                   "serving.slo.", "serving.hbm.", "serving.pool.",
+                   # ISSUE 10: the device-cost + flight-recorder planes
+                   "device.compile.", "device.exec.", "device.xfer.",
+                   "flightrec."):
         assert any(n.startswith(family) for n in code), (family, code)
+    # ISSUE 10: the device-cost observability surface must stay in the
+    # scan (created in obs/devprof and obs/flightrec)
+    for name in ("device.compile.count", "device.exec.ms",
+                 "device.xfer.h2d_bytes", "device.xfer.d2h_bytes",
+                 "flightrec.ring.events", "flightrec.dump.written"):
+        assert name in code, name
     # ISSUE 9: the epoch-compaction byte/fallback surface must stay in
     # the scan (created in overlay/compactor AND via the _LIVE_COUNTERS
     # template the plane iterates)

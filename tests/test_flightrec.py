@@ -1,0 +1,329 @@
+"""Flight recorder + postmortem bundles + /healthz (ISSUE 10).
+
+Acceptance coverage: a forced job failure produces a self-contained
+postmortem bundle whose span tree MATCHES ``GET /trace?job=<id>`` and
+whose device-event section is non-empty; ``GET /jobs/<id>`` references
+the bundle; ``POST /debug/dump`` / ``GET /debug/dumps`` work over HTTP
+(409 / disabled without a recorder); and ``GET /healthz`` reports
+liveness + readiness (ready ⇔ open scheduler with a live worker, pool
+can lease, ledger not in host-merge fallback).
+
+Kernel runs reuse the n=192/m=900/seed-42 smoke bucket
+(tests/test_serving.py); recorder-only units use no kernels at all.
+"""
+
+import json
+import os
+import shutil
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import titan_tpu
+from titan_tpu.obs.flightrec import BUNDLE_FORMAT, FlightRecorder
+from titan_tpu.olap.api import JobSpec
+from titan_tpu.olap.recovery import FaultPlan
+from titan_tpu.olap.serving.scheduler import JobScheduler
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.server import GraphServer
+from titan_tpu.utils.metrics import MetricManager
+
+_N = 192
+
+
+def _sym_snapshot(seed: int = 42, n: int = _N, m: int = 900):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    return snap_mod.from_arrays(n, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+
+
+@pytest.fixture(scope="module")
+def snap_main():
+    return _sym_snapshot()
+
+
+# ---------------------------------------------------------------------------
+# recorder units (no kernels)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_is_bounded_and_filterable(tmp_path):
+    mm = MetricManager()
+    rec = FlightRecorder(str(tmp_path), capacity=8, metrics=mm)
+    for i in range(20):
+        rec.record("tick", i=i)
+    evts = rec.events()
+    assert len(evts) == 8                      # oldest 12 dropped
+    assert [e["i"] for e in evts] == list(range(12, 20))
+    rec.record("other")                        # displaces one tick
+    assert len(rec.events("tick")) == 7
+    assert all(e["kind"] == "tick" for e in rec.events("tick"))
+    assert mm.counter_value("flightrec.ring.events") == 21
+
+
+def test_metric_delta_journals_counter_movement(tmp_path):
+    mm = MetricManager()
+    rec = FlightRecorder(str(tmp_path), metrics=mm)
+    mm.counter("serving.jobs.submitted").inc(3)
+    rec.metric_delta()
+    mm.counter("serving.jobs.submitted").inc(2)
+    rec.metric_delta()
+    rec.metric_delta()                         # no movement: no event
+    deltas = rec.events("metrics")
+    assert len(deltas) == 2
+    assert deltas[0]["delta"]["serving.jobs.submitted"] == 3
+    assert deltas[1]["delta"]["serving.jobs.submitted"] == 2
+
+
+def test_dump_bundle_is_parseable_and_atomic(tmp_path):
+    mm = MetricManager()
+    rec = FlightRecorder(str(tmp_path), metrics=mm, clock=lambda: 123.0)
+    rec.record("span", trace="j1", name="round", start=1.0, end=2.0,
+               attrs={"frontier": np.int64(7)})   # numpy must not throw
+    path = rec.dump(reason="manual", job={"job": "j1"},
+                    state={"pool": {"entries": 1}},
+                    config={"max_batch": 8})
+    bundle = json.load(open(path))
+    assert bundle["format"] == BUNDLE_FORMAT
+    assert bundle["dumped_at"] == 123.0
+    assert bundle["reason"] == "manual"
+    assert bundle["rounds"][0]["attrs"]["frontier"] == 7
+    assert bundle["state"]["pool"]["entries"] == 1
+    assert not [f for f in os.listdir(tmp_path)
+                if f.endswith(".tmp")]            # rename committed
+    assert mm.counter_value("flightrec.dump.written") == 1
+    idx = rec.index()
+    assert idx[0]["path"] == path and idx[0]["bytes"] > 0
+
+
+def test_dump_rounds_are_per_job_and_capped(tmp_path):
+    rec = FlightRecorder(str(tmp_path), metrics=MetricManager(),
+                         max_rounds_in_dump=4)
+    for i in range(10):
+        rec.record("span", trace="a", name="round", start=i, end=i)
+        rec.record("span", trace="b", name="round", start=i, end=i)
+    path = rec.dump(reason="failed", job={"job": "a"})
+    bundle = json.load(open(path))
+    assert len(bundle["rounds"]) == 4            # last-N only
+    assert all(r["trace"] == "a" for r in bundle["rounds"])
+    assert bundle["rounds"][-1]["start"] == 9
+
+
+def test_unwritable_dump_dir_counts_errors(tmp_path):
+    mm = MetricManager()
+    d = tmp_path / "dumps"
+    rec = FlightRecorder(str(d), metrics=mm)
+    shutil.rmtree(d)                             # storage vanished
+    with pytest.raises(OSError):
+        rec.dump(reason="manual")
+    assert mm.counter_value("flightrec.dump.errors") == 1
+    assert mm.counter_value("flightrec.dump.written") == 0
+    assert rec.index() == []                     # index survives
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: the acceptance path
+# ---------------------------------------------------------------------------
+
+
+def _sched(snap, tmp_path, **kw):
+    return JobScheduler(snapshot=snap, metrics=MetricManager(),
+                        flight_dir=str(tmp_path), **kw)
+
+
+def test_forced_failure_writes_matching_bundle(snap_main, tmp_path):
+    """ISSUE 10 acceptance: FAILED job → bundle with (a) a span tree
+    byte-equal to GET /trace's, (b) a non-empty device-event section,
+    (c) >= 1 round record for the job, referenced from the job wire."""
+    sched = _sched(snap_main, tmp_path, checkpoint_dir=str(
+        tmp_path / "ck"))
+    try:
+        job = sched.submit(JobSpec(
+            kind="bfs",
+            params={"source_dense": 0,
+                    "faults": FaultPlan(crash_at_round=2)},
+            checkpoint_every=1))
+        job.wait(60)
+        assert job.state.value == "failed"
+        deadline = time.time() + 10              # dump lands just after
+        while job.dump_path is None and time.time() < deadline:
+            time.sleep(0.02)
+        assert job.dump_path and os.path.exists(job.dump_path)
+        bundle = json.load(open(job.dump_path))
+        assert bundle["format"] == BUNDLE_FORMAT
+        assert bundle["reason"] == "failed"
+        # (a) span tree == the trace endpoint's view, terminal included
+        tree = sched.tracer.tree(job.id)
+        assert json.loads(json.dumps(tree)) == bundle["span_tree"]
+        names = []
+
+        def walk(n):
+            names.append(n["name"])
+            [walk(c) for c in n["children"]]
+        for root in bundle["span_tree"]["spans"]:
+            walk(root)
+        assert "failed" in names and "round" in names
+        # (b) the profiler fed the ring: device events present
+        assert bundle["device_events"], "device-event section empty"
+        assert bundle["device_totals"]["calls"] > 0
+        # (c) per-round records for THIS job
+        assert bundle["rounds"]
+        assert all(r["trace"] == job.id for r in bundle["rounds"])
+        # referenced from the wire envelope
+        assert job.to_wire()["postmortem"] == job.dump_path
+        # system state rides along
+        assert bundle["state"]["scheduler"]["running_batch"] == 0
+        assert bundle["config"]["max_batch"] == sched.max_batch
+    finally:
+        sched.close()
+
+
+def test_first_retry_dumps_once(snap_main, tmp_path):
+    """RETRYING (attempt 2) writes the evidence bundle while it is
+    fresh; the successful resume does NOT write another."""
+    sched = _sched(snap_main, tmp_path, checkpoint_dir=str(
+        tmp_path / "ck"))
+    try:
+        job = sched.submit(JobSpec(
+            kind="bfs",
+            params={"source_dense": 0,
+                    "faults": FaultPlan(crash_at_round=2)},
+            max_retries=1, checkpoint_every=1))
+        job.wait(60)
+        assert job.state.value == "done"
+        dumps = sched.recorder.index()
+        assert len(dumps) == 1
+        bundle = json.load(open(dumps[0]["path"]))
+        assert bundle["reason"] == "retrying"
+        assert bundle["job"]["job"] == job.id
+    finally:
+        sched.close()
+
+
+def test_dump_debug_on_demand_and_unknown_job(snap_main, tmp_path):
+    sched = _sched(snap_main, tmp_path)
+    try:
+        path = sched.dump_debug()
+        assert json.load(open(path))["reason"] == "manual"
+        with pytest.raises(ValueError):
+            sched.dump_debug("no-such-job")
+    finally:
+        sched.close()
+
+
+def test_no_flight_dir_means_no_plane(snap_main):
+    sched = JobScheduler(snapshot=snap_main, metrics=MetricManager())
+    try:
+        assert sched.recorder is None
+        assert sched.tracer.tap is None
+        with pytest.raises(ValueError):
+            sched.dump_debug()
+        job = sched.submit(JobSpec(
+            kind="bfs", params={"source_dense": 0,
+                                "faults": FaultPlan(crash_at_round=2)}))
+        job.wait(60)
+        assert job.state.value == "failed"
+        assert job.dump_path is None             # nothing written
+        assert "postmortem" not in job.to_wire()
+    finally:
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: /healthz, /debug/dump, /debug/dumps
+# ---------------------------------------------------------------------------
+
+
+def _req(srv, path, payload=None, method="GET"):
+    req = urllib.request.Request(
+        f"http://{srv.host}:{srv.port}{path}",
+        data=json.dumps(payload).encode() if payload is not None
+        else None,
+        headers={"Content-Type": "application/json"}, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def served_flight(snap_main, tmp_path):
+    g = titan_tpu.open("inmemory")
+    sched = _sched(snap_main, tmp_path)
+    srv = GraphServer(g, port=0, scheduler=sched).start()
+    yield srv, sched, tmp_path
+    srv.stop()
+    sched.close()
+    g.close()
+
+
+def test_healthz_ready_and_checks(served_flight):
+    srv, sched, _ = served_flight
+    code, body = _req(srv, "/healthz")
+    assert code == 200
+    assert body["live"] is True and body["ready"] is True
+    assert body["checks"]["scheduler_open"] is True
+    assert "snapshot" in body["checks"]["snapshot_pool"] \
+        or "fixed" in body["checks"]["snapshot_pool"]
+    assert body["checks"]["ledger_ok"] is True
+
+
+def test_healthz_not_ready_without_live_worker(snap_main, tmp_path):
+    """Readiness is falsifiable: a scheduler whose worker never started
+    (autostart=False) answers 503 with the failing check named."""
+    g = titan_tpu.open("inmemory")
+    sched = JobScheduler(snapshot=snap_main, metrics=MetricManager(),
+                         autostart=False)
+    srv = GraphServer(g, port=0, scheduler=sched).start()
+    try:
+        code, body = _req(srv, "/healthz")
+        assert code == 503
+        assert body["live"] is True and body["ready"] is False
+        assert body["checks"]["scheduler_open"] is False
+    finally:
+        srv.stop()
+        sched.close()
+        g.close()
+
+
+def test_debug_dump_and_index_over_http(served_flight):
+    srv, sched, tmp = served_flight
+    code, body = _req(srv, "/debug/dumps")
+    assert code == 200
+    assert body["enabled"] is True and body["dumps"] == []
+    code, body = _req(srv, "/debug/dump", {}, method="POST")
+    assert code == 200
+    assert os.path.exists(body["path"])
+    code, body = _req(srv, "/debug/dumps")
+    assert body["enabled"] is True
+    assert len(body["dumps"]) == 1
+    assert body["dumps"][0]["file"].startswith("dump-")
+    # anchored to an unknown job: a clean 400, no bundle written
+    code, body = _req(srv, "/debug/dump", {"job": "nope"},
+                      method="POST")
+    assert code == 400
+    # valid JSON but not an object: still a client-error 400, not 500
+    code, body = _req(srv, "/debug/dump", [1], method="POST")
+    assert code == 400
+    assert len(sched.recorder.index()) == 1
+
+
+def test_debug_dump_409_without_recorder(snap_main):
+    g = titan_tpu.open("inmemory")
+    sched = JobScheduler(snapshot=snap_main, metrics=MetricManager())
+    srv = GraphServer(g, port=0, scheduler=sched).start()
+    try:
+        code, body = _req(srv, "/debug/dump", {}, method="POST")
+        assert code == 409
+        code, body = _req(srv, "/debug/dumps")
+        assert code == 200 and body["enabled"] is False
+    finally:
+        srv.stop()
+        sched.close()
+        g.close()

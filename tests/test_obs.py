@@ -322,3 +322,73 @@ def test_gauges_render_with_children_and_escaping():
             'window="300s"} 2.5' in text)
     # programmatic roll-up read still available (additive families)
     assert m.gauge_value("serving.slo.burn_rate") == 2.5
+
+
+# ---------------------------------------------------------------------------
+# histogram quantile memo: scrape-vs-record (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_quantile_memo_invalidates_on_update():
+    """The sorted reservoir is cached on the sample-count watermark:
+    two reads without an update share ONE sort, any update (including
+    reservoir replacement past max_samples) invalidates it."""
+    from titan_tpu.utils.metrics import Histogram
+
+    h = Histogram(max_samples=64)
+    for v in range(10):
+        h.update(float(v))
+    first = h._sorted_samples()
+    assert h._sorted_samples() is first          # memo hit: same list
+    assert h.percentile(50) == 4.0 or h.percentile(50) == 5.0
+    h.update(100.0)
+    second = h._sorted_samples()
+    assert second is not first                   # watermark moved
+    assert h.to_dict()["max"] == 100.0
+    # past max_samples every update still bumps count -> still fresh
+    for v in range(200):
+        h.update(float(v))
+    assert len(h._sorted_samples()) == 64
+    assert h._sorted_samples() == sorted(h.values())
+
+
+def test_histogram_concurrent_scrape_vs_record_stress():
+    """Prometheus scrapes (p50+p95 via to_dict / render) racing a
+    recording thread must never throw, and every scrape must see a
+    coherent sorted view (p50 <= p95, count monotone)."""
+    m = MetricManager()
+    h = m.histogram("serving.job.latency_ms")
+    stop = threading.Event()
+    errors = []
+
+    def recorder():
+        v = 0
+        while not stop.is_set():
+            h.update(float(v % 997))
+            v += 1
+
+    def scraper():
+        last_count = 0
+        while not stop.is_set():
+            try:
+                d = h.to_dict()
+                assert d["p50"] <= d["p95"] <= d["max"] + 1e-9
+                assert d["count"] >= last_count
+                last_count = d["count"]
+                text = render_prometheus(m)
+                assert "serving_job_latency_ms" in text
+            except Exception as e:               # pragma: no cover
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=recorder) for _ in range(2)] + \
+              [threading.Thread(target=scraper) for _ in range(2)]
+    for t in threads:
+        t.start()
+    import time as _time
+    _time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(5)
+    assert not errors, errors
+    assert h.to_dict()["count"] > 0

@@ -120,6 +120,11 @@ def build_chunked_csr(snap):
         + np.arange(len(dst_by_src), dtype=np.int64)
     flat[pos] = dst_by_src
     dstT = np.ascontiguousarray(flat.reshape(q_total, 8).T)
+    # device-cost seam (obs/devprof): the chunked-CSR upload is the
+    # dominant H2D cost of a cold snapshot — count it once per build
+    from titan_tpu.obs import devprof
+    devprof.count_h2d("bfs.chunked_csr",
+                      dstT.nbytes + 3 * (n + 1) * 4)
     out = {
         "dstT": jnp.asarray(dstT),
         "colstart": jnp.asarray(colstart.astype(np.int32)),
@@ -1056,6 +1061,8 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
         levels[act_h] = level
     out = dist[:, :n]
     if not return_device:
+        from titan_tpu.obs import devprof
+        devprof.count_d2h("bfs.dist", out.nbytes)
         out = np.asarray(out)
     return out, levels, completed
 

@@ -1,0 +1,299 @@
+"""Device-cost profiler: compile / dispatch / transfer telemetry.
+
+The layer that actually decides latency on a TPU — XLA compilations,
+per-kernel device wall time, H2D/D2H traffic — was invisible outside
+hand-run benches (ISSUE 10). This module makes it a first-class metric
+surface:
+
+* **Interception**: every kernel fetched through
+  ``utils/jitcache.jit_once`` (the whole bfs_hybrid / frontier kernel
+  library) is shimmed; the shim hands calls to ``_dispatch`` below when
+  a profiler is installed. The engine's module-level jits
+  (``olap/tpu/engine.py``) and eager device passes
+  (``ops/epoch_merge``) route through :func:`profiled` explicitly.
+* **Compile accounting**: a cache MISS is detected per call from the
+  jit's ``_cache_size()`` delta — one miss == one new static shape
+  bucket compiled; backend compile wall time is attributed through a
+  ``jax.monitoring`` duration listener + a thread-local call context
+  (eager-op compiles inside a profiled window are attributed too).
+* **Transfer accounting**: the upload/readback seams
+  (``engine._device_graph_single``, ``bfs_hybrid.build_chunked_csr``,
+  the overlay's delta pages, result readbacks) call
+  :func:`count_h2d` / :func:`count_d2h` with their byte counts.
+* **Export**: ``device.compile.*`` / ``device.exec.*`` /
+  ``device.xfer.*`` metric families through the labeled-metrics core
+  (children keyed by ``{kernel}`` / ``{site}``), scraped by the
+  Prometheus exposition like every other family
+  (docs/monitoring.md table, pinned by tests/test_docs_metrics.py).
+
+Profilers install process-wide (kernel caches are process-wide state);
+more than one may be installed (tests, bench windows) — measurement
+happens ONCE per call and fans out. With no profiler installed every
+hook is one module-global load + None check; the profiler never touches
+the device computation itself, so kernel results are bit-equal with
+profiling on or off (pinned by tests/test_devprof.py, alongside the
+1.15x overhead guard).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from titan_tpu.utils import jitcache
+from titan_tpu.utils.metrics import MetricManager
+
+#: installed profilers, in install order (process-wide — kernel caches
+#: are process-wide; tier-1 runs serially so tests stay deterministic)
+_PROFILERS: list = []
+_INSTALL_LOCK = threading.Lock()
+_TLS = threading.local()
+_LISTENER = {"on": False}
+
+
+def _on_jax_event(name: str, duration_s: float, **_kw) -> None:
+    """jax.monitoring duration listener: attribute backend-compile wall
+    time to the profiled call in flight on this thread (if any)."""
+    if not _PROFILERS or not name.endswith("backend_compile_duration"):
+        return
+    ctx = getattr(_TLS, "ctx", None)
+    if ctx is not None:
+        ctx["compile_s"] += duration_s
+        ctx["compile_events"] += 1
+
+
+def _ensure_listener() -> None:
+    # jax has no per-listener unregister; register once, gate on
+    # _PROFILERS inside the callback
+    if _LISTENER["on"]:
+        return
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_jax_event)
+        _LISTENER["on"] = True
+    except Exception:
+        pass
+
+
+def _dispatch(key: str, fn, args, kwargs):
+    """The jitcache profile dispatch: measure once, fan out to every
+    installed profiler. ``fn`` is the RAW jitted function (its
+    ``_cache_size`` delta detects a per-shape-bucket compile)."""
+    if not _PROFILERS:
+        return fn(*args, **kwargs)
+    cache_size = getattr(fn, "_cache_size", None)
+    before = cache_size() if cache_size is not None else -1
+    prev = getattr(_TLS, "ctx", None)
+    ctx = _TLS.ctx = {"compile_s": 0.0, "compile_events": 0}
+    t0 = time.perf_counter()
+    try:
+        out = fn(*args, **kwargs)
+    finally:
+        _TLS.ctx = prev
+        wall = time.perf_counter() - t0
+        after = cache_size() if cache_size is not None else -1
+        compiled = after > before >= 0
+        for prof in list(_PROFILERS):
+            prof.on_call(key, wall, compiled, ctx["compile_s"],
+                         ctx["compile_events"])
+    return out
+
+
+def profiled(key: str, fn, *args, **kwargs):
+    """Run ``fn(*args, **kwargs)`` under the active profilers — the
+    explicit form for device entry points that don't come from
+    jit_once (the engine's module jits, eager epoch-merge passes)."""
+    if not _PROFILERS:
+        return fn(*args, **kwargs)
+    return _dispatch(key, fn, args, kwargs)
+
+
+def count_h2d(site: str, nbytes: int) -> None:
+    """Attribute ``nbytes`` of host→device transfer to ``site``."""
+    if _PROFILERS and nbytes:
+        for prof in list(_PROFILERS):
+            prof.on_xfer("h2d", site, int(nbytes))
+
+
+def count_d2h(site: str, nbytes: int) -> None:
+    """Attribute ``nbytes`` of device→host readback to ``site``."""
+    if _PROFILERS and nbytes:
+        for prof in list(_PROFILERS):
+            prof.on_xfer("d2h", site, int(nbytes))
+
+
+def current() -> Optional["DeviceCostProfiler"]:
+    """The most recently installed profiler, or None."""
+    return _PROFILERS[-1] if _PROFILERS else None
+
+
+class DeviceCostProfiler:
+    """Process-wide device-cost accounting into a metrics registry.
+
+    Per profiled call: ``device.exec.calls`` / ``device.exec.ms``
+    (labeled ``{kernel}``); a compile (new static shape bucket) counts
+    on ``device.compile.count`` + ``device.compile.ms``, a warm call on
+    ``device.compile.cache_hits``. Transfer seams land on
+    ``device.xfer.h2d_bytes`` / ``device.xfer.d2h_bytes`` (labeled
+    ``{site}``). A bounded ``compile_log`` keeps the recent compile
+    events for postmortem bundles, and ``window()`` captures totals
+    deltas for per-stage / per-job attribution.
+
+    ``recorder`` (obs/flightrec.FlightRecorder) receives a compact
+    device event per call when attached.
+    """
+
+    def __init__(self, metrics: Optional[MetricManager] = None,
+                 recorder=None, max_compile_log: int = 256):
+        self.metrics = metrics or MetricManager.instance()
+        self.recorder = recorder
+        self.max_compile_log = int(max_compile_log)
+        self._lock = threading.Lock()
+        self._kernels: dict[str, dict] = {}
+        self._compile_log: list[dict] = []
+        self._totals = {"calls": 0, "compiles": 0, "cache_hits": 0,
+                        "compile_s": 0.0, "exec_s": 0.0,
+                        "h2d_bytes": 0, "d2h_bytes": 0}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def install(self) -> "DeviceCostProfiler":
+        with _INSTALL_LOCK:
+            if self not in _PROFILERS:
+                _PROFILERS.append(self)
+            _ensure_listener()
+            jitcache.set_profile_dispatch(_dispatch)
+        return self
+
+    def uninstall(self) -> None:
+        with _INSTALL_LOCK:
+            if self in _PROFILERS:
+                _PROFILERS.remove(self)
+            if not _PROFILERS:
+                jitcache.set_profile_dispatch(None)
+
+    def __enter__(self) -> "DeviceCostProfiler":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    @property
+    def installed(self) -> bool:
+        return self in _PROFILERS
+
+    # -- record side ---------------------------------------------------------
+
+    def on_call(self, key: str, wall_s: float, compiled: bool,
+                compile_s: float, compile_events: int) -> None:
+        m = self.metrics
+        m.counter("device.exec.calls", labels={"kernel": key}).inc()
+        m.histogram("device.exec.ms",
+                    labels={"kernel": key}).update(wall_s * 1e3)
+        if compiled:
+            m.counter("device.compile.count",
+                      labels={"kernel": key}).inc()
+            m.histogram("device.compile.ms",
+                        labels={"kernel": key}).update(compile_s * 1e3)
+        else:
+            m.counter("device.compile.cache_hits",
+                      labels={"kernel": key}).inc()
+        with self._lock:
+            k = self._kernels.setdefault(
+                key, {"calls": 0, "compiles": 0, "cache_hits": 0,
+                      "compile_s": 0.0, "compile_events": 0,
+                      "exec_s": 0.0})
+            k["calls"] += 1
+            k["exec_s"] += wall_s
+            k["compile_s"] += compile_s
+            k["compile_events"] += compile_events
+            t = self._totals
+            t["calls"] += 1
+            t["exec_s"] += wall_s
+            t["compile_s"] += compile_s
+            if compiled:
+                k["compiles"] += 1
+                t["compiles"] += 1
+                self._compile_log.append(
+                    {"t": time.time(), "kernel": key,
+                     "compile_ms": round(compile_s * 1e3, 3),
+                     "call_ms": round(wall_s * 1e3, 3)})
+                if len(self._compile_log) > self.max_compile_log:
+                    del self._compile_log[0]
+            else:
+                k["cache_hits"] += 1
+                t["cache_hits"] += 1
+        rec = self.recorder
+        if rec is not None:
+            rec.record("device", kernel=key,
+                       ms=round(wall_s * 1e3, 3), compiled=compiled,
+                       **({"compile_ms": round(compile_s * 1e3, 3)}
+                          if compiled else {}))
+
+    def on_xfer(self, direction: str, site: str, nbytes: int) -> None:
+        name = "device.xfer.h2d_bytes" if direction == "h2d" \
+            else "device.xfer.d2h_bytes"
+        self.metrics.counter(name, labels={"site": site}).inc(nbytes)
+        with self._lock:
+            self._totals[f"{direction}_bytes"] += nbytes
+        rec = self.recorder
+        if rec is not None:
+            rec.record("xfer", dir=direction, site=site, bytes=nbytes)
+
+    # -- read side -----------------------------------------------------------
+
+    def kernel_stats(self) -> dict:
+        """Per-kernel accumulated stats (calls / compiles / cache hits /
+        compile + exec seconds), keyed by jit_once key."""
+        with self._lock:
+            return {k: dict(v) for k, v in sorted(self._kernels.items())}
+
+    def compiles(self, key: Optional[str] = None) -> int:
+        """Compilations so far — one per (kernel, static shape bucket)
+        cache miss; total when ``key`` is None."""
+        with self._lock:
+            if key is not None:
+                k = self._kernels.get(key)
+                return k["compiles"] if k is not None else 0
+            return self._totals["compiles"]
+
+    def compile_log(self) -> list:
+        """The last ``max_compile_log`` compile events (newest last) —
+        the postmortem/evidence "compile log" section."""
+        with self._lock:
+            return [dict(e) for e in self._compile_log]
+
+    def stats(self) -> dict:
+        """Process totals: calls / compiles / cache hits, compile and
+        exec wall seconds, H2D/D2H bytes."""
+        with self._lock:
+            out = dict(self._totals)
+        out["compile_s"] = round(out["compile_s"], 6)
+        out["exec_s"] = round(out["exec_s"], 6)
+        return out
+
+    def window(self) -> "ProfileWindow":
+        """Open a totals-delta window (per-stage / per-batch
+        attribution). Concurrent activity from other threads lands in
+        every open window — windows measure the process, not a thread."""
+        return ProfileWindow(self)
+
+
+class ProfileWindow:
+    """Totals snapshot at open; ``close()`` returns the delta."""
+
+    __slots__ = ("_prof", "_t0", "_base")
+
+    def __init__(self, prof: DeviceCostProfiler):
+        self._prof = prof
+        self._t0 = time.time()
+        self._base = prof.stats()
+
+    def close(self) -> dict:
+        now = self._prof.stats()
+        out = {k: now[k] - self._base[k] for k in now}
+        out["compile_s"] = round(out["compile_s"], 6)
+        out["exec_s"] = round(out["exec_s"], 6)
+        out["wall_s"] = round(time.time() - self._t0, 6)
+        return out

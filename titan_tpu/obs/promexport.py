@@ -68,6 +68,22 @@ HELP = {
     "metrics.labels.dropped":
         "labeled lookups degraded to their unlabeled parent by the "
         "per-name cardinality cap",
+    "device.compile.count":
+        "XLA compilations (one per kernel x static shape bucket), "
+        "by kernel",
+    "device.compile.cache_hits":
+        "profiled kernel calls served from the jit cache, by kernel",
+    "device.compile.ms":
+        "backend compile wall time per compilation, by kernel",
+    "device.exec.calls": "profiled kernel dispatches, by kernel",
+    "device.exec.ms": "per-call device wall time, by kernel",
+    "device.xfer.h2d_bytes":
+        "host-to-device bytes by upload site",
+    "device.xfer.d2h_bytes":
+        "device-to-host readback bytes by site",
+    "flightrec.ring.events": "events journaled into the flight ring",
+    "flightrec.dump.written": "postmortem bundles written",
+    "flightrec.dump.errors": "postmortem bundle writes that failed",
 }
 
 _ILLEGAL = re.compile(r"[^a-zA-Z0-9_:]")

@@ -137,6 +137,11 @@ class Tracer:
         self.enabled = enabled
         self.max_spans = int(max_spans)
         self.max_traces = int(max_traces)
+        # flight-recorder seam (obs/flightrec, ISSUE 10): a callable
+        # invoked with each COMPLETED span (from end/event) so the
+        # bounded ring journals the span stream; None costs one
+        # attribute check per completion
+        self.tap = None
         self._traces: "OrderedDict[str, _Trace]" = OrderedDict()
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
@@ -167,6 +172,9 @@ class Tracer:
             return
         span.set(**attrs)
         span.t_end = self.clock() if t_end is None else t_end
+        tap = self.tap
+        if tap is not None:
+            tap(span)
 
     def event(self, trace_id: str, name: str, parent=None,
               t0: Optional[float] = None, t1: Optional[float] = None,
@@ -185,6 +193,9 @@ class Tracer:
             s.t_end = t1
         else:
             s.t_end = now if t0 is not None else s.t_start
+        tap = self.tap
+        if tap is not None:
+            tap(s)
         return s
 
     @contextmanager

@@ -277,6 +277,11 @@ class DeltaOverlay:
         if self._metrics is not None and nbytes:
             self._metrics.counter("serving.live.upload_bytes") \
                 .inc(int(nbytes))
+        # device-cost mirror (obs/devprof, ISSUE 10): the same delta
+        # pages on the process-wide device.xfer.h2d_bytes family, so
+        # the profiler's transfer story includes live-plane traffic
+        from titan_tpu.obs import devprof
+        devprof.count_h2d("overlay.delta", int(nbytes))
 
     def view(self) -> OverlayView:
         """Freeze the current state into an immutable device view.

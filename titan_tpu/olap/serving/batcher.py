@@ -384,6 +384,9 @@ class Batcher:
                     max_rounds=int(params.get("max_rounds", 10_000)),
                     on_round=on_round, checkpoint=ckpt, resume=resume,
                     overlay=overlay)
+                from titan_tpu.obs import devprof
+                devprof.count_d2h("frontier.result",
+                                  getattr(dist, "nbytes", 0))
                 dist = np.asarray(dist)
                 job.complete({"rounds": int(rounds),
                               "reached": int((dist < float(FINF)).sum()),
@@ -430,6 +433,9 @@ class Batcher:
                 lab, rounds = frontier_wcc(snap, on_round=on_round,
                                            checkpoint=ckpt, resume=resume,
                                            overlay=overlay)
+                from titan_tpu.obs import devprof
+                devprof.count_d2h("frontier.result",
+                                  getattr(lab, "nbytes", 0))
                 lab = np.asarray(lab)
                 job.complete({"rounds": int(rounds),
                               "components": int(len(np.unique(lab))),

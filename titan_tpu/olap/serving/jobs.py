@@ -105,6 +105,10 @@ class Job:
         # execution hooks test this ONE attribute, so tracing-off costs
         # nothing per round
         self.trace = None
+        # postmortem bundle path (obs/flightrec): set by the scheduler
+        # when an abnormal end wrote a dump — GET /jobs/<id> references
+        # it so a triager can jump from the job to its bundle
+        self.dump_path: Optional[str] = None
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._cancel = threading.Event()
@@ -261,6 +265,8 @@ class Job:
             out["exec_ms"] = round(e * 1e3, 3)
         if self.error is not None:
             out["error"] = self.error
+        if self.dump_path is not None:
+            out["postmortem"] = self.dump_path
         if self.result is not None:
             out["result"] = {
                 k: v for k, v in self.result.items()

@@ -249,6 +249,24 @@ class SnapshotPool:
                         return self._lease_locked(snap)
                 # a commit landed inside refresh(): loop and re-check
 
+    def ready(self) -> tuple:
+        """Readiness probe (``GET /healthz``, ISSUE 10): can this pool
+        hand out a current-epoch snapshot right now? (ok, why) — True
+        when the pool is open and holds a snapshot source: a live plane
+        publishing its epoch, a fixed snapshot, or a graph to
+        build/refresh from."""
+        with self._lock:
+            if self._closed:
+                return False, "pool closed"
+            if self._live is not None:
+                return True, f"live plane at epoch {self._live.epoch}"
+            if self._fixed is not None:
+                return True, "fixed snapshot resident"
+            if self.graph is not None:
+                return True, (f"graph-backed "
+                              f"({len(self._entries)} resident)")
+            return False, "no snapshot source"
+
     def stats(self) -> dict:
         with self._lock:
             out = {"entries": len(self._entries),

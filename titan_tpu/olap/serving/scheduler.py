@@ -41,6 +41,20 @@ Metrics (utils/metrics.MetricManager):
   serving.hbm.{resident_bytes,pinned_bytes} + serving.pool.snapshots
                                  (callback gauges over the ledger/pool)
 
+Device-cost observability (titan_tpu/obs/devprof + flightrec, ISSUE
+10): the scheduler installs a process-wide DeviceCostProfiler by
+default (``profiling=False`` / TITAN_TPU_PROFILING=0 removes it) —
+XLA compiles per static shape bucket, per-kernel device wall and
+H2D/D2H bytes land on the ``device.*`` families, and each executed
+batch's device cost is stitched into its jobs' traces as a
+``device_cost`` span (split over K, like the device-seconds
+accounting). ``flight_dir=`` (or TITAN_TPU_FLIGHT_DIR) attaches a
+FlightRecorder: a bounded ring journals spans / device events /
+counter deltas, and a job that entered execution and ended FAILED /
+TIMEOUT / CANCELLED — or its first RETRYING transition — writes a
+self-contained postmortem bundle (``job.dump_path``, ``GET
+/debug/dumps``, on-demand via ``dump_debug``).
+
 Tenancy (olap/serving/tenants, ISSUE 8): every job belongs to a tenant
 (``spec.tenant``, falling back to "default"); the per-job counters and
 latency/queue histograms write through {kind, tenant}-labeled children
@@ -69,6 +83,8 @@ import threading
 import time
 from typing import Optional
 
+from titan_tpu.obs import devprof
+from titan_tpu.obs.flightrec import FlightRecorder
 from titan_tpu.obs.tracing import TraceHandle, Tracer
 from titan_tpu.olap.api import JobSpec
 from titan_tpu.olap.serving.batcher import Batcher, batch_key
@@ -101,7 +117,11 @@ class JobScheduler:
                  tracing: Optional[bool] = None,
                  quotas: Optional[dict] = None,
                  enforce_quotas: bool = False,
-                 slos=None, slo_clock=None):
+                 slos=None, slo_clock=None,
+                 profiling: Optional[bool] = None,
+                 profiler=None,
+                 flight_dir: Optional[str] = None,
+                 flight_capacity: int = 4096):
         # observability plane (titan_tpu/obs): one tracer per scheduler,
         # one trace per job (trace id == job id) — submit/queue/attempt
         # spans here, fuse/run/round/checkpoint spans in the batcher &
@@ -114,6 +134,38 @@ class JobScheduler:
                     .lower() not in ("0", "false", "off")
             tracer = Tracer(enabled=tracing)
         self.tracer = tracer
+        self._metrics = metrics or MetricManager.instance()
+        # flight recorder (obs/flightrec): only exists when a dump
+        # directory is configured — no ring, no taps, no files without
+        # one. The tracer tap journals every completed span into the
+        # bounded ring (round-mass tuples ride in round-span attrs)
+        self.recorder = None
+        if flight_dir is None:
+            flight_dir = os.environ.get("TITAN_TPU_FLIGHT_DIR") or None
+        if flight_dir:
+            self.recorder = FlightRecorder(flight_dir,
+                                           capacity=flight_capacity,
+                                           metrics=self._metrics)
+            self.tracer.tap = self.recorder.span_tap
+        # device-cost profiler (obs/devprof): process-wide interception
+        # of the jit entry points (jitcache shim + engine seams) —
+        # compile-per-bucket, per-kernel device wall, H2D/D2H bytes as
+        # device.* metric families; default ON, one flag removes it
+        self.profiler = None
+        self._own_profiler = False
+        if profiler is not None:
+            self.profiler = profiler
+        else:
+            if profiling is None:
+                profiling = os.environ.get(
+                    "TITAN_TPU_PROFILING", "1").lower() \
+                    not in ("0", "false", "off")
+            if profiling:
+                self.profiler = devprof.DeviceCostProfiler(
+                    metrics=self._metrics, recorder=self.recorder)
+                self._own_profiler = True
+        if self._own_profiler:
+            self.profiler.install()
         # live plane (olap/live): jobs lease (snapshot, overlay) pairs
         # at a consistent epoch instead of refresh/rebuild churn; the
         # scheduler OWNS the plane's lifecycle once attached (close()
@@ -142,7 +194,7 @@ class JobScheduler:
                 lambda snap: self._evictable.setdefault(id(snap), snap))
         self.batcher = Batcher(max_batch=max_batch)
         self.max_batch = max_batch
-        self._metrics = metrics or MetricManager.instance()
+        # (self._metrics was bound before the recorder/profiler above)
         # tenancy plane (olap/serving/tenants): authoritative per-tenant
         # attribution behind GET /tenants; quotas check at submit()
         # behind the enforce flag (default OFF = shadow mode: violations
@@ -239,6 +291,10 @@ class JobScheduler:
                 g.set(0.0)
         if self.slo is not None:
             self.slo.detach_gauges()
+        # detach OUR process-wide profiler (a caller-provided one stays
+        # the caller's to uninstall)
+        if self._own_profiler and self.profiler is not None:
+            self.profiler.uninstall()
 
     def _evict(self, key) -> None:
         """HBM eviction: drop the snapshot's cached device CSR (arrays
@@ -432,6 +488,70 @@ class JobScheduler:
         from titan_tpu.obs.tracing import trace_summary
         return trace_summary(self.tracer, job_id)
 
+    # -- postmortems (obs/flightrec) ----------------------------------------
+
+    def _dump_config(self) -> dict:
+        """The scheduler's effective configuration for the bundle —
+        enough to reproduce the serving posture without the process."""
+        return {"max_batch": self.max_batch,
+                "hbm_budget_bytes": self.ledger.budget_bytes,
+                "tracing": self.tracer.enabled,
+                "profiling": self.profiler is not None,
+                "checkpoints": self.ckpt_store is not None,
+                "live": self.live is not None,
+                "enforce_quotas": self.enforce_quotas,
+                "quotas": {t: q.to_wire()
+                           for t, q in sorted(self.quotas.items())}}
+
+    def _dump(self, job: Optional[Job], reason: str) -> Optional[str]:
+        """Write a postmortem bundle for ``job`` (or a whole-system
+        snapshot when None); never raises into the worker path."""
+        if self.recorder is None:
+            return None
+        try:
+            path = self.recorder.dump(
+                reason=reason,
+                job=job.to_wire() if job is not None else None,
+                span_tree=self.tracer.tree(job.id)
+                if job is not None and self.tracer.enabled else None,
+                state={"scheduler": self.stats(),
+                       "ledger": {
+                           "resident_bytes":
+                               self.ledger.resident_bytes(),
+                           "pinned_bytes": self.ledger.pinned_bytes(),
+                           "budget_bytes": self.ledger.budget_bytes},
+                       "pool": self.pool.stats(),
+                       "live": self.live_stats()},
+                config=self._dump_config(),
+                profiler=self.profiler)
+        except Exception:
+            # dump.errors already counted by the recorder; a broken
+            # dump directory must never take the worker down
+            return None
+        if job is not None:
+            job.dump_path = path
+        return path
+
+    def dump_debug(self, job_id: Optional[str] = None,
+                   reason: str = "manual") -> str:
+        """On-demand postmortem (``POST /debug/dump``): dump the ring +
+        state now, optionally anchored to a job. Raises ValueError for
+        an unknown job id or when no flight recorder is attached."""
+        if self.recorder is None:
+            raise ValueError("flight recorder disabled — construct the "
+                             "scheduler with flight_dir= (or set "
+                             "TITAN_TPU_FLIGHT_DIR)")
+        job = None
+        if job_id is not None:
+            job = self.get(job_id)
+            if job is None:
+                raise ValueError(f"unknown job {job_id!r}")
+        path = self._dump(job, reason=reason)
+        if path is None:
+            raise RuntimeError("postmortem dump failed (see "
+                               "flightrec.dump.errors)")
+        return path
+
     def stats(self) -> dict:
         with self._cv:
             depth = sum(1 for *_x, j in self._heap
@@ -494,6 +614,14 @@ class JobScheduler:
                 "serving.job.latency_ms",
                 labels=self._job_labels(job)).update(
                 (job.finished_at - job.submitted_at) * 1e3)
+        # postmortem (obs/flightrec): a job that ENTERED execution and
+        # ended abnormally — FAILED, TIMEOUT, or a mid-flight kill —
+        # writes its bundle now, AFTER the terminal span stamped above,
+        # so the dump's span tree matches GET /trace exactly
+        if self.recorder is not None and job.started_at is not None \
+                and job.state in (JobState.FAILED, JobState.TIMEOUT,
+                                  JobState.CANCELLED):
+            self._dump(job, reason=job.state.value)
 
     def _pop_group(self) -> list[Job]:
         """Under the cv lock: pop the head runnable job + compatible
@@ -547,18 +675,29 @@ class JobScheduler:
         worker's RETRYING check and this call neither requeues a
         terminal job nor counts a phantom retry."""
         with self._cv:
-            if job.state is not JobState.RETRYING:
-                self._finalize_metrics(job)
-                return
-            self._metrics.counter("serving.recovery.retries").inc()
-            if job.trace is not None:
-                job.trace.event(
-                    "retrying", parent=job.trace.root,
-                    attempt=job.attempt,
-                    backoff_s=round(max(0.0, (job.not_before or 0)
-                                        - time.time()), 4),
-                    **({"error": job.error} if job.error else {}))
-            self._push_locked(job)
+            requeued = job.state is JobState.RETRYING
+            if requeued:
+                self._metrics.counter("serving.recovery.retries").inc()
+                if job.trace is not None:
+                    job.trace.event(
+                        "retrying", parent=job.trace.root,
+                        attempt=job.attempt,
+                        backoff_s=round(max(0.0, (job.not_before or 0)
+                                            - time.time()), 4),
+                        **({"error": job.error} if job.error else {}))
+                self._push_locked(job)
+        if not requeued:
+            # cancel raced the RETRYING check: finalize OUTSIDE the cv
+            # — a terminal job that entered execution dumps its
+            # postmortem here, and the bundle write (ring + state
+            # serialized to disk) must never stall the scheduler API
+            self._finalize_metrics(job)
+            return
+        # postmortem on the FIRST retry (the failure evidence is
+        # freshest now; later attempts overwrite nothing — each dump
+        # file is its own sequence-numbered bundle)
+        if self.recorder is not None and job.attempt == 2:
+            self._dump(job, reason="retrying")
 
     def _run(self) -> None:
         while True:
@@ -619,6 +758,31 @@ class JobScheduler:
             if hbm_share:
                 self.tenants.hbm_byte_seconds(job.tenant, hbm_share)
 
+    def _stitch_device_cost(self, group: list[Job], cost: dict) -> None:
+        """Per-job device-cost attribution (obs/devprof, ISSUE 10):
+        the executed batch's profiler window — kernel calls, compiles,
+        compile/exec wall, H2D/D2H bytes — lands on each member's trace
+        as a ``device_cost`` event, with the divisible costs split
+        evenly over the K fused jobs exactly like the device-seconds
+        accounting (the whole point of fusion is that a job's share IS
+        total/K). Compile and call counts stay batch-wide: a compile is
+        shared, not divisible."""
+        if not cost["calls"]:
+            return
+        k = len(group)
+        for job in group:
+            h = job.trace
+            if h is None:
+                continue
+            h.event("device_cost", k=k,
+                    kernel_calls=cost["calls"],
+                    compiles=cost["compiles"],
+                    compile_ms_share=round(cost["compile_s"] * 1e3 / k,
+                                           3),
+                    exec_ms_share=round(cost["exec_s"] * 1e3 / k, 3),
+                    h2d_bytes_share=cost["h2d_bytes"] // k,
+                    d2h_bytes_share=cost["d2h_bytes"] // k)
+
     def _execute(self, group: list[Job]) -> None:
         head = group[0]
         # cancel raced between pop and start: honor it before any work
@@ -651,6 +815,8 @@ class JobScheduler:
             for job in group:
                 self.batcher.run_single(job, None)
             self._attribute(group, time.time() - t0, 0)
+            if self.recorder is not None:
+                self.recorder.metric_delta()
             return
         spec = head.spec
         edge_keys = tuple(spec.edge_keys or ())
@@ -698,6 +864,8 @@ class JobScheduler:
             for job in group:
                 self.tenants.hold_hbm(job.tenant, share)
             t0 = time.time()
+            w = self.profiler.window() if self.profiler is not None \
+                else None
             try:
                 if len(group) > 1 or batch_key(spec) is not None:
                     self.batcher.run_bfs_batch(group, snap,
@@ -711,3 +879,7 @@ class JobScheduler:
                     self.tenants.drop_hbm(job.tenant, share)
                 self._attribute(group, wall, nbytes)
                 self.ledger.unpin(ledger_key)
+                if w is not None:
+                    self._stitch_device_cost(group, w.close())
+                if self.recorder is not None:
+                    self.recorder.metric_delta()

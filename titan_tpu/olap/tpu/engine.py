@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from titan_tpu.obs import devprof
 from titan_tpu.olap.api import DenseProgram
 from titan_tpu.olap.tpu.snapshot import GraphSnapshot
 from titan_tpu.ops.segment import combine_identity, segment_combine
@@ -272,6 +273,10 @@ def _device_graph_single(snap: GraphSnapshot):
     if cached is None:
         from titan_tpu.ops.segment import segment_metadata
         li, sh = segment_metadata(snap.indptr_in)
+        devprof.count_h2d(
+            "engine.graph",
+            snap.src.nbytes + snap.dst.nbytes + li.nbytes + sh.nbytes
+            + sum(v.nbytes for v in snap.edge_values.values()))
         cached = (jnp.asarray(snap.src), jnp.asarray(snap.dst),
                   {k: jnp.asarray(v) for k, v in snap.edge_values.items()},
                   (jnp.asarray(li), jnp.asarray(sh)))
@@ -309,9 +314,9 @@ def run_single(program: DenseProgram, snap: GraphSnapshot,
     max_iter = program.max_iterations
     every = int(checkpoint_every or 0)
     if checkpoint is None or every <= 0:
-        state, iters, _ = _iterate_single(program, state, src, dst, edata,
-                                          seg_meta, tparams, it, max_iter,
-                                          n=n)
+        state, iters, _ = devprof.profiled(
+            "engine.iterate_single", _iterate_single, program, state,
+            src, dst, edata, seg_meta, tparams, it, max_iter, n=n)
         it = int(iters)
     else:
         done = False
@@ -319,13 +324,17 @@ def run_single(program: DenseProgram, snap: GraphSnapshot,
             # next cadence boundary (cadence-aligned regardless of the
             # resume point, so checkpoint rounds are stable identifiers)
             it_end = min(max_iter, (it // every + 1) * every)
-            state, iters, done_dev = _iterate_single(
-                program, state, src, dst, edata, seg_meta, tparams,
-                it, it_end, n=n)
+            state, iters, done_dev = devprof.profiled(
+                "engine.iterate_single", _iterate_single, program,
+                state, src, dst, edata, seg_meta, tparams, it, it_end,
+                n=n)
             it = int(iters)
             done = bool(done_dev)
             checkpoint(it, state)
     outputs = program.outputs(state, params)
+    devprof.count_d2h("engine.outputs",
+                      sum(getattr(v, "nbytes", 0)
+                          for v in outputs.values()))
     return TPUEngineResult({k: np.asarray(v) for k, v in outputs.items()},
                            it, n)
 
@@ -413,7 +422,8 @@ def run_single_batched(program: DenseProgram, snap: GraphSnapshot,
         if program.edge_keys() else edata
     vparams = {k: jnp.stack([jnp.asarray(p[k]) for p in params_list])
                for k in keys}
-    state, iters, it_done = _iterate_batched(
+    state, iters, it_done = devprof.profiled(
+        "engine.iterate_batched", _iterate_batched,
         program, state, src, dst, edata, seg_meta, vparams,
         max_iter=program.max_iterations, n=n)
     it_done_h = np.asarray(it_done)
@@ -421,6 +431,9 @@ def run_single_batched(program: DenseProgram, snap: GraphSnapshot,
     results = []
     for i, p in enumerate(params_list):
         out = program.outputs({k: v[i] for k, v in state.items()}, p)
+        devprof.count_d2h("engine.outputs",
+                          sum(getattr(v, "nbytes", 0)
+                              for v in out.values()))
         results.append(TPUEngineResult(
             {k: np.asarray(v) for k, v in out.items()},
             int(it_done_h[i]) or iters_h, n))
@@ -513,8 +526,9 @@ def _run_sharded_csr(program: DenseProgram, sc: ShardedCSR, params: dict,
         if k not in edata_cache:
             edata_cache[k] = jnp.asarray(sc.edge_values[k])
         edata[k] = edata_cache[k]
-    state, iters = mapped(state0, src_g, dst_l, valid, last_idx_d, seg_has_d,
-                          edata)
+    state, iters = devprof.profiled(
+        "engine.iterate_sharded", mapped, state0, src_g, dst_l, valid,
+        last_idx_d, seg_has_d, edata)
     outputs = program.outputs({k: v[:n] for k, v in state.items()}, params)
     return TPUEngineResult({k: np.asarray(v) for k, v in outputs.items()},
                            int(iters), n)

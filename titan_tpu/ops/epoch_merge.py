@@ -175,7 +175,20 @@ def merge_chunked_csr(csr: dict, view, *, q_total_new: int,
     caller attaches the ``_host`` mirrors via the delta-page sync).
     Raises ``ValueError`` on inputs the int32 layout cannot express —
     callers catch and take the host path.
+
+    Routed through the device-cost profiler (obs/devprof, ISSUE 10):
+    the merge is an eager device-op sequence, so its per-epoch wall and
+    any eager-op compiles land on the ``device.exec.* / device.compile
+    .*`` families under kernel ``ops.epoch_merge``.
     """
+    from titan_tpu.obs import devprof
+    return devprof.profiled("ops.epoch_merge", _merge_chunked_csr,
+                            csr, view, q_total_new=q_total_new,
+                            e_base=e_base)
+
+
+def _merge_chunked_csr(csr: dict, view, *, q_total_new: int,
+                       e_base: int) -> dict:
     import jax.numpy as jnp
 
     n = int(csr["n"])

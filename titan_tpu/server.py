@@ -55,6 +55,23 @@ Endpoints:
                       current SLI and multi-window error-budget burn
                       rates; {"enabled": false} when the scheduler has
                       no objectives attached
+  GET  /healthz     — liveness + readiness (ISSUE 10, the health-check
+                      hook a replica fleet needs): 200 when ready, 503
+                      with per-check detail otherwise. Ready ⇔ the
+                      scheduler is open with a live worker, the
+                      snapshot pool can hand out a current-epoch
+                      snapshot, and the live plane's ledger is not
+                      degraded into host-merge fallback. This is the
+                      ONE probe that lazily constructs the scheduler —
+                      readiness means "this replica can serve", so the
+                      probe warms the serving stack on purpose.
+  POST /debug/dump  — on-demand postmortem bundle (obs/flightrec):
+                      body {"job": <id>} (optional) → 200 {"path"}.
+                      409 when the scheduler has no flight recorder
+                      (flight_dir / TITAN_TPU_FLIGHT_DIR unset).
+  GET  /debug/dumps — index of postmortem bundles in the dump
+                      directory (file/bytes/mtime, newest first);
+                      {"enabled": false} without a recorder
   GET  /metrics     — Prometheus text exposition of every registered
                       counter/timer/histogram/gauge, labeled children
                       included (titan_tpu/obs/promexport;
@@ -140,6 +157,21 @@ def wire_error(e: BaseException) -> tuple[int, dict]:
     return 500, {**env, "retryable": False}
 
 
+def _ledger_ok(live_stats: Optional[dict]) -> bool:
+    """The /healthz "ledger not in fallback" check: with no live plane
+    there is no fallback state to be in; with one, ready means the
+    compactor's LAST merge was not a host fallback while device
+    merging is configured on (a host-mode epoch under device_merge
+    means the ledger could not hold two epochs — serving limps, the
+    replica should shed load until compaction recovers)."""
+    if live_stats is None:
+        return True
+    comp = live_stats.get("compactor") or {}
+    if not comp.get("device_merge", False):
+        return True
+    return comp.get("merge_mode") != "host"
+
+
 class GraphServer:
     """Hosts one open graph; evaluate() is the script-engine seam.
 
@@ -201,6 +233,30 @@ class GraphServer:
         with self._sched_lock:
             sched = self._scheduler
         return sched if sched is not None and not sched.closed else None
+
+    def health(self) -> tuple[bool, dict]:
+        """Readiness evaluation behind ``GET /healthz`` (unit-testable
+        without HTTP). Intentionally constructs the scheduler when
+        missing: readiness asserts "this replica can serve", which
+        includes being able to stand the serving stack up."""
+        checks: dict = {}
+        try:
+            sched = self.scheduler()
+        except Exception as e:
+            checks["scheduler"] = f"error: {type(e).__name__}: {e}"
+            return False, checks
+        worker = sched._worker
+        checks["scheduler_open"] = ok_sched = (
+            not sched.closed
+            and worker is not None and worker.is_alive())
+        pool_ok, why = sched.pool.ready()
+        checks["snapshot_pool"] = why
+        try:
+            live = sched.live_stats()
+        except Exception:
+            live = None
+        checks["ledger_ok"] = lok = _ledger_ok(live)
+        return ok_sched and pool_ok and lok, checks
 
     def submit_job(self, body: dict):
         """Wire body → JobSpec → scheduler (shared by POST /jobs and the
@@ -334,6 +390,23 @@ class GraphServer:
                         "backend": g.backend.manager.name,
                         "computer": g.config.get(d.COMPUTER_BACKEND),
                         "metrics": metrics})
+                elif self.path == "/healthz":
+                    ready, checks = server.health()
+                    self._send(200 if ready else 503,
+                               {"live": True, "ready": ready,
+                                "checks": checks})
+                elif self.path == "/debug/dumps":
+                    # postmortem index (obs/flightrec) — answered from
+                    # the live scheduler only (a monitoring probe must
+                    # not construct one; cf. /tenants)
+                    sched = server.live_scheduler()
+                    rec = sched.recorder if sched is not None else None
+                    if rec is None:
+                        self._send(200, {"enabled": False, "dumps": []})
+                    else:
+                        self._send(200, {"enabled": True,
+                                         "dump_dir": rec.dump_dir,
+                                         "dumps": rec.index()})
                 elif self.path == "/metrics":
                     from titan_tpu.obs.promexport import (CONTENT_TYPE,
                                                           render_prometheus)
@@ -426,12 +499,43 @@ class GraphServer:
             def do_POST(self):
                 if not self._authorized():
                     return
-                if self.path not in ("/traversal", "/jobs"):
+                if self.path not in ("/traversal", "/jobs",
+                                     "/debug/dump"):
                     self._send(404, {"error": f"unknown path {self.path}",
                                      "type": "NotFound",
                                      "retryable": False})
                     return
                 length = int(self.headers.get("Content-Length", 0))
+                if self.path == "/debug/dump":
+                    # on-demand postmortem: dump the flight ring + full
+                    # system state now, optionally anchored to a job
+                    sched = server.live_scheduler()
+                    if sched is None or sched.recorder is None:
+                        self._send(409, {
+                            "error": "flight recorder disabled — start "
+                                     "the scheduler with flight_dir= "
+                                     "(or TITAN_TPU_FLIGHT_DIR)",
+                            "type": "Conflict", "retryable": False})
+                        return
+                    try:
+                        body = json.loads(
+                            self.rfile.read(length) or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError(
+                                "body must be a JSON object")
+                        path = sched.dump_debug(body.get("job"))
+                    except (json.JSONDecodeError, ValueError) as e:
+                        self._send(400, {"error": str(e),
+                                         "type": type(e).__name__,
+                                         "retryable": False})
+                        return
+                    except BaseException as e:
+                        self._send(*wire_error(e))
+                        return
+                    import os as _os
+                    self._send(200, {"path": path,
+                                     "file": _os.path.basename(path)})
+                    return
                 if self.path == "/jobs":
                     from titan_tpu.olap.serving.tenants import \
                         QuotaExceeded

@@ -9,9 +9,44 @@ PERF_NOTES.md).
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 _JITS: dict = {}
+
+# device-cost observability seam (titan_tpu/obs/devprof, ISSUE 10):
+# every kernel fetched through jit_once is wrapped in a shim that hands
+# the call to the installed profile dispatch — (key, raw_fn, args,
+# kwargs) -> result — which counts compiles per static shape bucket
+# (cache hit vs miss via the jit's _cache_size delta), per-call wall
+# time and compile time. The dispatch lives here as a plain module
+# global so utils/ never imports obs/: devprof sets it on install and
+# clears it when the last profiler uninstalls, leaving the off-path at
+# ONE global load + None check per kernel call.
+_PROFILE_DISPATCH: Optional[Callable] = None
+
+
+def set_profile_dispatch(dispatch: Optional[Callable]) -> None:
+    """Install (or clear, with None) the process-wide profile dispatch
+    used by every jit_once shim. Owned by titan_tpu/obs/devprof."""
+    global _PROFILE_DISPATCH
+    _PROFILE_DISPATCH = dispatch
+
+
+def _profile_shim(key: str, raw):
+    """Wrap a freshly built kernel so the active profiler (if any) sees
+    every call. The raw jitted function stays reachable as
+    ``__wrapped__`` (tests and the dispatch read ``_cache_size`` off
+    it)."""
+
+    def shim(*args, **kwargs):
+        dispatch = _PROFILE_DISPATCH
+        if dispatch is None:
+            return raw(*args, **kwargs)
+        return dispatch(key, raw, args, kwargs)
+
+    shim.__name__ = getattr(raw, "__name__", key)
+    shim.__wrapped__ = raw
+    return shim
 
 
 def enable_compile_cache(path: str | None = None) -> None:
@@ -37,10 +72,12 @@ def enable_compile_cache(path: str | None = None) -> None:
 
 def jit_once(key: str, builder: Callable):
     """Return the cached jitted function for ``key``, building it with
-    ``builder()`` on first use."""
+    ``builder()`` on first use. The cached function is profile-shimmed
+    (see ``_profile_shim``) — a no-op unless a device-cost profiler is
+    installed."""
     fn = _JITS.get(key)
     if fn is None:
-        fn = builder()
+        fn = _profile_shim(key, builder())
         _JITS[key] = fn
     return fn
 

@@ -101,15 +101,16 @@ class Timer:
         return self.total_ns / self.count if self.count else 0.0
 
 
-def nearest_rank(samples, q: float) -> float:
+def nearest_rank(samples, q: float, *, presorted: bool = False) -> float:
     """Nearest-rank percentile (``q`` in [0, 1]) over a value list —
     THE percentile definition of the whole plane: Histogram.percentile,
     the SLO engine's pooled p95 and bench's per-tenant lines all call
     this one function, so they can never drift apart. Unsorted input
-    accepted; empty reads 0.0."""
+    accepted (``presorted=True`` skips the sort — Histogram's memoized
+    reservoir path); empty reads 0.0."""
     if not samples:
         return 0.0
-    s = sorted(samples)
+    s = samples if presorted else sorted(samples)
     rank = max(0, min(len(s) - 1, int(round(q * (len(s) - 1)))))
     return s[rank]
 
@@ -138,6 +139,13 @@ class Histogram:
         self.max = 0.0
         self._rng_state = (self.DEFAULT_SEED if seed is None
                            else int(seed) & (2**64 - 1)) or 1
+        # quantile memo (ISSUE 10 satellite): a Prometheus scrape reads
+        # p50 AND p95 off every histogram; re-sorting the full reservoir
+        # per read made scrape cost O(scrapes * histograms * n log n).
+        # The sorted reservoir is cached and keyed on the update-count
+        # watermark — EVERY update bumps ``count`` (including reservoir
+        # replacements), so a stale cache is impossible.
+        self._sorted_memo: Optional[tuple] = None   # (count, sorted)
         self._lock = threading.Lock()
 
     def _rand(self, bound: int) -> int:
@@ -165,19 +173,32 @@ class Histogram:
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
 
+    def _sorted_samples(self) -> list:
+        """The sorted reservoir, memoized on the sample-count watermark
+        (one sort per update generation however many quantiles are
+        read). Readers get the shared list — treat it as immutable."""
+        with self._lock:
+            memo = self._sorted_memo
+            if memo is not None and memo[0] == self.count:
+                return memo[1]
+            s = sorted(self._samples)
+            self._sorted_memo = (self.count, s)
+            return s
+
     def percentile(self, q: float) -> float:
         """q in [0, 100]; nearest-rank over the reservoir."""
-        with self._lock:
-            samples = list(self._samples)
-        return nearest_rank(samples, q / 100.0)
+        return nearest_rank(self._sorted_samples(), q / 100.0,
+                            presorted=True)
 
     def to_dict(self) -> dict:
+        s = self._sorted_samples()   # ONE sort feeds both quantiles
         return {"count": self.count, "mean": self.mean, "min": self.min,
                 "max": self.max, "total": self.total,
-                "p50": self.percentile(50), "p95": self.percentile(95),
+                "p50": nearest_rank(s, 0.5, presorted=True),
+                "p95": nearest_rank(s, 0.95, presorted=True),
                 # how many reservoir samples back the percentiles —
                 # below max_samples they are exact, not estimates
-                "samples": len(self._samples)}
+                "samples": len(s)}
 
     def values(self) -> list:
         """Reservoir snapshot (unordered) — the SLO engine pools these
