@@ -70,6 +70,7 @@ _EST = {
     "live_refresh": (90,   0.3),   # host-array merges + one s20 upload
     "serving":   (90,      0.1),   # small-graph batched BFS + retry
     "tenancy":   (60,      0.1),   # shares serving's kernel shapes
+    "interactive": (90,    0.1),   # hops-mode fuse sweep + batched PPR
 }
 # nominal fast-day H2D rate (GB/s): bfs26's 9GB uploaded in 16.35s
 # (BENCH_r05); the headline stage's measured upload re-prices this
@@ -785,6 +786,130 @@ def tenancy_stage(rep: Report, scale: int) -> None:
     rep.emit()
 
 
+def interactive_stage(rep: Report, scale: int) -> None:
+    """ISSUE 11 evidence stage (ROADMAP #3): the interactive lane's
+    fuse economics as first-class metric lines — per-query p50/p95 of
+    2-hop point queries fused K=16 vs run sequentially (K=1), the fuse
+    occupancy histogram, and batched personalized-PageRank throughput
+    (one vmapped [S, n] dispatch) vs S sequential personalized runs.
+    CPU-meaningful; a chip day re-captures with the tunnel in the
+    loop."""
+    import threading
+
+    from titan_tpu.models.frontier import pagerank_dense
+    from titan_tpu.models.pagerank import pagerank_personalized_batched
+    from titan_tpu.olap.serving.interactive import plan_from_wire
+    from titan_tpu.olap.serving.scheduler import JobScheduler
+    from titan_tpu.olap.tpu import snapshot as snap_mod
+    from titan_tpu.utils.metrics import MetricManager, nearest_rank
+
+    rng = np.random.default_rng(42)
+    n = 1 << scale
+    m = n * 8
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    snap = snap_mod.from_arrays(n, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+    K = 16
+
+    def q(vid):
+        return plan_from_wire({"start": [int(vid)], "dir": "both",
+                               "hops": 2, "terminal": "count"})
+
+    sources = rng.integers(0, n, K)
+    metrics = MetricManager()            # isolated: bench-only lines
+    # fused lane: a window long enough that a thread burst always
+    # lands in ONE batch; solo lane: near-zero window, every query its
+    # own dispatch (the K=1 reference)
+    fused = JobScheduler(snapshot=snap, metrics=metrics,
+                         autostart=False, interactive_window_s=0.05,
+                         interactive_max_fuse=K)
+    solo = JobScheduler(snapshot=snap, metrics=MetricManager(),
+                        autostart=False, interactive_window_s=1e-4)
+    try:
+        lane_f, lane_s = fused.interactive(), solo.interactive()
+        # warm both XLA shape buckets (K=16 padded, K=1)
+        lane_s.submit(q(sources[0]))
+        warm = [threading.Thread(
+            target=lambda v=v: lane_f.submit(q(v))) for v in sources]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join(60)
+        fused_ms: list = []
+        exec_ms: list = []
+
+        def go(vid):
+            t0 = time.time()
+            res = lane_f.submit(q(vid))
+            fused_ms.append((time.time() - t0) * 1e3)
+            exec_ms.append(res["exec_ms"])
+
+        reps = 3
+        for _ in range(reps):
+            threads = [threading.Thread(target=go, args=(v,))
+                       for v in sources]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+        seq_ms: list = []
+        for _ in range(reps):
+            for vid in sources:
+                t0 = time.time()
+                lane_s.submit(q(vid))
+                seq_ms.append((time.time() - t0) * 1e3)
+        occ = metrics.histogram(
+            "serving.interactive.fuse_k").to_dict()
+        # batched PPR throughput vs sequential personalized oracle
+        S, iters = 8, 10
+        ppr_src = [int(v) for v in sources[:S]]
+        ppr_dense = [snap.dense_of(v) for v in ppr_src]
+        pagerank_personalized_batched(snap, ppr_dense,
+                                      iterations=iters)  # warm
+        t0 = time.time()
+        pagerank_personalized_batched(snap, ppr_dense,
+                                      iterations=iters)
+        batched_s = time.time() - t0
+        reset0 = np.zeros(snap.n, np.float32)
+        reset0[ppr_dense[0]] = 1.0
+        pagerank_dense(snap, iterations=iters, reset=reset0)  # warm
+        t0 = time.time()
+        for sd in ppr_dense:
+            reset = np.zeros(snap.n, np.float32)
+            reset[sd] = 1.0
+            pagerank_dense(snap, iterations=iters, reset=reset)
+        seq_s = time.time() - t0
+        rep.detail["interactive"] = {
+            "scale": scale, "edges_sym": 2 * m, "k": K,
+            "point_query_fused_p50_ms": round(
+                nearest_rank(fused_ms, 0.5), 3),
+            "point_query_fused_p95_ms": round(
+                nearest_rank(fused_ms, 0.95), 3),
+            "point_query_seq_p50_ms": round(
+                nearest_rank(seq_ms, 0.5), 3),
+            "point_query_seq_p95_ms": round(
+                nearest_rank(seq_ms, 0.95), 3),
+            "fused_exec_ms_per_batch": round(
+                nearest_rank(exec_ms, 0.5), 3),
+            "fuse_occupancy": occ,
+            # device-economics headline: K queries' worth of answers
+            # per fused device dispatch vs K separate dispatches
+            "fused_device_ms_per_query": round(
+                nearest_rank(exec_ms, 0.5) / K, 4),
+            "ppr_users": S, "ppr_iterations": iters,
+            "ppr_batched_wall_s": round(batched_s, 3),
+            "ppr_seq_wall_s": round(seq_s, 3),
+            "ppr_batched_users_per_s": round(
+                S / max(batched_s, 1e-9), 1),
+            "ppr_speedup_x": round(seq_s / max(batched_s, 1e-9), 2),
+        }
+    finally:
+        fused.close()
+        solo.close()
+    rep.emit()
+
+
 def bfs_heavy_stage(rep: Report) -> None:
     """BASELINE row 5: Twitter-2010-class (1.5B-edge) single-chip BFS.
     The dataset itself is unreachable in-image (zero egress), so the
@@ -1093,6 +1218,7 @@ class Evidence:
         sharded = next((v for k, v in det.items()
                         if k.endswith("_sharded_1dev")), None)
         serving = det.get("serving")
+        interactive = det.get("interactive")
         return {
             "sharded_bfs": (present(sharded) if sharded is not None
                             else absent("bfs23_sharded")),
@@ -1108,6 +1234,18 @@ class Evidence:
             "recovery_replay": (present(serving["recovery"])
                                 if serving is not None
                                 else absent("serving")),
+            # ISSUE 11: the interactive lane's fuse economics — point
+            # queries K=16 vs sequential + batched-PPR throughput
+            "interactive_point_queries": (
+                present({k: interactive[k] for k in
+                         ("point_query_fused_p50_ms",
+                          "point_query_fused_p95_ms",
+                          "point_query_seq_p50_ms",
+                          "point_query_seq_p95_ms",
+                          "fuse_occupancy",
+                          "ppr_batched_users_per_s",
+                          "ppr_speedup_x")})
+                if interactive is not None else absent("interactive")),
         }
 
     def write(self) -> None:
@@ -1217,6 +1355,11 @@ def main() -> None:
         # exactness — same scale as serving so the kernels stay warm
         ("tenancy", lambda: tenancy_stage(
             rep, 16 if on_accel else min(headline_scale, 12))),
+        # interactive lane evidence (ISSUE 11): 2-hop point queries
+        # fused K=16 vs sequential + batched-PPR throughput — the
+        # fuse-economics lines ROADMAP #3 asked for
+        ("interactive", lambda: interactive_stage(
+            rep, 14 if on_accel else min(headline_scale, 12))),
         # the sharded-overhead stage also times the plain hybrid at the
         # warm scale, so it outranks the standalone warm stage when the
         # budget is tight

@@ -1,0 +1,121 @@
+#!/usr/bin/env bash
+# Interactive-lane smoke (ISSUE 11): start the HTTP server on an
+# in-memory gods graph, fire 6 concurrent POST /traverse point queries
+# through the wire, and assert they all fuse into ONE [K, n] device
+# batch with results equal to the dsl interpreter; then a batched
+# personalized-PageRank recommendation request and a LOUD interpreter
+# fallback. Prints the lane's p50 from serving.interactive.latency_ms.
+# The in-CI twin lives in tests/test_serving_interactive.py; this
+# script proves the out-of-process deployment surface end to end.
+#
+# Usage: scripts/traverse_smoke.sh   (CPU-safe; ~30s incl. XLA compiles)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python - <<'EOF'
+import json
+import threading
+import urllib.request
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import titan_tpu
+from titan_tpu import example
+from titan_tpu.olap.serving.scheduler import JobScheduler
+from titan_tpu.server import GraphServer
+
+g = titan_tpu.open("inmemory")
+example.load(g)
+# a generous fuse window so the concurrent burst lands in ONE batch —
+# the fusion assertion is then deterministic
+sched = JobScheduler(graph=g, autostart=False,
+                     interactive_window_s=0.3)
+srv = GraphServer(g, port=0, scheduler=sched).start()
+print(f"traverse_smoke: server on {srv.host}:{srv.port}")
+
+
+def req(path, payload=None, method="GET"):
+    r = urllib.request.Request(
+        f"http://{srv.host}:{srv.port}{path}",
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"}, method=method)
+    with urllib.request.urlopen(r, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+vids = req("/traversal",
+           {"gremlin": "sorted(v.id for v in g.V().to_list())"},
+           method="POST")["result"][:6]
+assert len(vids) == 6
+
+# warm the XLA shape buckets so the measured burst is steady-state
+req("/traverse", {"start": [vids[0]], "dir": "both", "hops": 2,
+                  "terminal": "id"}, method="POST")
+
+out = {}
+errors = []
+
+
+def point_query(vid):
+    try:
+        out[vid] = req("/traverse",
+                       {"start": [vid], "dir": "both", "hops": 2,
+                        "terminal": "id"}, method="POST")
+    except Exception as e:
+        errors.append(repr(e))
+
+
+threads = [threading.Thread(target=point_query, args=(v,))
+           for v in vids]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(60)
+assert not errors, errors
+assert len(out) == 6
+
+# ONE fused device batch served all six users
+ks = {b["fused_k"] for b in out.values()}
+batches = {b["batch"] for b in out.values()}
+assert ks == {6}, f"expected one K=6 fuse, got fused_k={ks}"
+assert len(batches) == 1, batches
+print(f"traverse_smoke: 6 concurrent point queries fused into "
+      f"{batches.pop()} (K=6)")
+
+# every user's answer is bit-equal to the dsl interpreter
+for vid, b in out.items():
+    ref = req("/traversal",
+              {"gremlin": f"g.V({vid}).both().both().dedup().id_()"},
+              method="POST")["result"]
+    assert sorted(b["result"]) == sorted(ref), (vid, b["result"], ref)
+    assert b["fallback"] is False
+print("traverse_smoke: all 6 results equal the interpreter")
+
+# batched personalized PageRank through the same lane
+ppr = req("/traverse", {"kind": "ppr", "source": vids[0],
+                        "iterations": 10, "top_k": 5}, method="POST")
+assert ppr["fallback"] is False and 0 < len(ppr["result"]) <= 5, ppr
+print(f"traverse_smoke: ppr top-{len(ppr['result'])} for "
+      f"{vids[0]}: {ppr['result'][:3]}")
+
+# an uncompilable chain answers via the interpreter, LOUDLY
+fb = req("/traverse",
+         {"gremlin": f"g.V({vids[0]}).out().out().count()"},
+         method="POST")
+assert fb["fallback"] is True and "why" in fb, fb
+prom = urllib.request.urlopen(
+    f"http://{srv.host}:{srv.port}/metrics", timeout=30).read().decode()
+fallbacks = [line for line in prom.splitlines()
+             if line.startswith("serving_interactive_fallbacks")]
+assert fallbacks and float(fallbacks[0].split()[-1]) >= 1, fallbacks
+print("traverse_smoke: uncompilable chain fell back loudly "
+      f"({fallbacks[0]})")
+
+lat = sched._metrics.histogram("serving.interactive.latency_ms")
+print(f"traverse_smoke: lane p50 = {lat.to_dict()['p50']:.3f} ms "
+      f"over {lat.count} compiled queries")
+srv.stop()
+g.close()
+print("traverse_smoke: OK")
+EOF

@@ -191,9 +191,12 @@ def test_round_loop_modules_are_nonzero_free():
     overlay views feed per-round expansion passes; (ISSUE r10) to
     obs/, whose tracing hooks run at every round boundary — since
     ISSUE 10 that includes devprof/flightrec, whose profiler shims and
-    ring taps wrap every kernel dispatch; and (ISSUE 9) to
+    ring taps wrap every kernel dispatch; (ISSUE 9) to
     ops/epoch_merge, the device epoch-merge kernel — every survivor
-    compaction there must go through ops.compaction."""
+    compaction there must go through ops.compaction; and (ISSUE 11) to
+    olap/serving/interactive/, whose hops-mode point queries run the
+    same per-level plan/sweep kernels (host-side set extraction uses
+    np.flatnonzero, which is not an n-wide device op-scan)."""
     import importlib
     import inspect
     import io
@@ -210,8 +213,18 @@ def test_round_loop_modules_are_nonzero_free():
     serving_mods = [
         importlib.import_module(f"titan_tpu.olap.serving.{m.name}")
         for m in pkgutil.iter_modules(serving_pkg.__path__)]
-    # jobs/pool/hbm/batcher/scheduler + tenants (ISSUE 8)
-    assert len(serving_mods) >= 6
+    # jobs/pool/hbm/batcher/scheduler + tenants (ISSUE 8) +
+    # the interactive subpackage (ISSUE 11)
+    assert len(serving_mods) >= 7
+    # the interactive lane (ISSUE 11) compiles point queries onto the
+    # batched round kernels — its compiler/collector/lane modules are
+    # in the ban too
+    import titan_tpu.olap.serving.interactive as interactive_pkg
+    interactive_mods = [
+        importlib.import_module(
+            f"titan_tpu.olap.serving.interactive.{m.name}")
+        for m in pkgutil.iter_modules(interactive_pkg.__path__)]
+    assert len(interactive_mods) >= 3   # compile/collector/scheduler
     recovery_mods = [
         importlib.import_module(f"titan_tpu.olap.recovery.{m.name}")
         for m in pkgutil.iter_modules(recovery_pkg.__path__)]
@@ -227,7 +240,8 @@ def test_round_loop_modules_are_nonzero_free():
     assert len(obs_mods) >= 5
 
     for mod in (frontier, bfs_hybrid, bfs_hybrid_sharded, epoch_merge,
-                *serving_mods, *recovery_mods, *live_mods, *obs_mods):
+                *serving_mods, *interactive_mods, *recovery_mods,
+                *live_mods, *obs_mods):
         src = inspect.getsource(mod)
         calls = [
             (tok.start[0], line)

@@ -94,8 +94,17 @@ def test_every_code_metric_documented_and_vice_versa():
                    "serving.slo.", "serving.hbm.", "serving.pool.",
                    # ISSUE 10: the device-cost + flight-recorder planes
                    "device.compile.", "device.exec.", "device.xfer.",
-                   "flightrec."):
+                   "flightrec.",
+                   # ISSUE 11: the interactive point-query lane
+                   "serving.interactive."):
         assert any(n.startswith(family) for n in code), (family, code)
+    # ISSUE 11: the interactive lane's fuse/fallback evidence must stay
+    # in the scan (created in olap/serving/interactive/scheduler.py)
+    for name in ("serving.interactive.requests",
+                 "serving.interactive.fallbacks",
+                 "serving.interactive.fuse_k",
+                 "serving.interactive.latency_ms"):
+        assert name in code, name
     # ISSUE 10: the device-cost observability surface must stay in the
     # scan (created in obs/devprof and obs/flightrec)
     for name in ("device.compile.count", "device.exec.ms",

@@ -351,3 +351,76 @@ def test_plane_host_mode_when_device_disabled(graph):
             >= snapshot_csr_bytes(snap1)
     finally:
         plane.close()
+
+
+# -- incremental out-CSR across merge_delta (ISSUE 11 satellite, the
+# ROADMAP #5 residual: the merged epoch's src-order argsort must not be
+# re-paid by the next overlay's slot-lookup index) -----------------------
+
+def _fresh_out_csr(merged):
+    """From-scratch recompute on an identical uncached snapshot."""
+    fresh = snap_mod.GraphSnapshot(
+        merged.n, merged.vertex_ids, merged.src, merged.dst,
+        merged.indptr_in, merged.out_degree, {}, merged.labels,
+        dict(merged.label_names))
+    dbs, ip = fresh.out_csr()
+    return dbs, ip, fresh._out_csr_order
+
+
+@pytest.mark.parametrize("seed", [3, SEED])
+@pytest.mark.parametrize("adds,removes", [(0, 0), (40, 0), (0, 60),
+                                          (50, 80)])
+def test_merge_delta_out_csr_incremental_bit_equal(seed, adds,
+                                                   removes):
+    snap, src, dst, labs, rng = _base(seed=seed, labeled=True)
+    snap.out_csr()                      # the overlay init's build
+    ov = _mutate(snap, src, dst, labs, rng, adds, removes)
+    a_src, a_dst, a_lab = ov.live_adds()
+    merged = snap_mod.merge_delta(snap, ~ov.tomb_row_mask, a_src,
+                                  a_dst, a_lab)
+    assert getattr(merged, "_out_csr", None) is not None, \
+        "merge_delta must carry the out-CSR cache incrementally"
+    got_dbs, got_ip = merged._out_csr
+    ref_dbs, ref_ip, ref_order = _fresh_out_csr(merged)
+    assert np.array_equal(got_dbs, ref_dbs)
+    assert np.array_equal(got_ip, ref_ip)
+    assert np.array_equal(np.asarray(merged._out_csr_order, np.int64),
+                          np.asarray(ref_order, np.int64))
+
+
+def test_overlay_slot_index_reuses_snapshot_order():
+    """The next epoch's DeltaOverlay reads the cached permutation (no
+    argsort): identity, and removals through it still kill the right
+    rows."""
+    snap, src, dst, labs, rng = _base(labeled=True)
+    ov0 = _mutate(snap, src, dst, labs, rng, 16, 8)
+    a_src, a_dst, a_lab = ov0.live_adds()
+    merged = snap_mod.merge_delta(snap, ~ov0.tomb_row_mask, a_src,
+                                  a_dst, a_lab)
+    ov1 = DeltaOverlay(merged, min_cap=64)
+    assert ov1._base_order() is merged._out_csr_order
+    # a removal resolved through the carried index tombstones a live
+    # base row (merge_delta output really is dst-sorted + consistent)
+    e = 5
+    assert ov1.remove_edge(int(merged.src[e]), int(merged.dst[e]),
+                           int(merged.labels[e]))
+    assert ov1.tomb_row_mask[e] or ov1.tomb_row_mask.sum() == 1
+
+
+def test_device_compaction_chain_keeps_out_csr_incremental():
+    """EpochCompactor's device path publishes a merged snapshot whose
+    out-CSR cache is pre-attached (and correct) — epoch N+1's overlay
+    never re-sorts."""
+    snap, src, dst, labs, rng = _base()
+    build_chunked_csr(snap)
+    ov = _mutate(snap, src, dst, labs, rng, 24, 12)
+    comp = EpochCompactor()
+    merged, mode = comp.compact(snap, ov)
+    assert mode == "device"
+    assert getattr(merged, "_out_csr", None) is not None
+    got_dbs, got_ip = merged._out_csr
+    ref_dbs, ref_ip, ref_order = _fresh_out_csr(merged)
+    assert np.array_equal(got_dbs, ref_dbs)
+    assert np.array_equal(got_ip, ref_ip)
+    assert np.array_equal(np.asarray(merged._out_csr_order, np.int64),
+                          np.asarray(ref_order, np.int64))

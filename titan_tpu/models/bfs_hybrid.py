@@ -85,6 +85,35 @@ END_C_CAP = 1 << 21
 END_P_CAP = 1 << 22
 
 
+def chunked_layout(payload, indptr, deg, n: int):
+    """The 8-aligned transposed chunk layout shared by the forward
+    chunked CSR below and the interactive lane's REVERSED orientation
+    (olap/serving/interactive/compile.reversed_chunked_csr) — one
+    definition of the pad convention and the int32 column guard.
+    Returns ``(dstT [8, Q] int32 host, colstart int64 [n+1], degc
+    int64 [n], q_total)``."""
+    degc = -(-deg // 8)
+    colstart = np.zeros(n + 1, np.int64)
+    np.cumsum(degc, out=colstart[1:])
+    q_total = int(colstart[-1]) + 1          # +1 all-pad column for the sink
+    if q_total >= (1 << 31):
+        raise NotImplementedError(
+            "chunked CSR uses int32 COLUMN indices; shard below 2^31 chunks")
+    # pad = n+1: OUT of range for dist[0..n], so pad-lane scatters are
+    # dropped and pad-lane gathers clamp to dist[n], which is never
+    # written and stays INF (writing the in-range sink n instead would
+    # leak level values into later bottom-up hit tests)
+    flat = np.full(q_total * 8, n + 1, np.int32)
+    # positions of each edge in the 8-aligned layout: vertex v's edge k
+    # lands at colstart[v]*8 + k
+    starts8 = colstart[:n] * 8
+    pos = np.repeat(starts8 - indptr[:n], deg[:n]) \
+        + np.arange(len(payload), dtype=np.int64)
+    flat[pos] = payload
+    dstT = np.ascontiguousarray(flat.reshape(q_total, 8).T)
+    return dstT, colstart, degc, q_total
+
+
 def build_chunked_csr(snap):
     """Host-side (cached): transposed 8-aligned out-CSR device arrays.
 
@@ -101,25 +130,8 @@ def build_chunked_csr(snap):
     n = snap.n
     dst_by_src, indptr_out = snap.out_csr()
     deg = snap.out_degree.astype(np.int64)
-    degc = -(-deg // 8)
-    colstart = np.zeros(n + 1, np.int64)
-    np.cumsum(degc, out=colstart[1:])
-    q_total = int(colstart[-1]) + 1          # +1 all-pad column for the sink
-    if q_total >= (1 << 31):
-        raise NotImplementedError(
-            "chunked CSR uses int32 COLUMN indices; shard below 2^31 chunks")
-    # pad = n+1: OUT of range for dist[0..n], so pad-lane scatters are
-    # dropped and pad-lane gathers clamp to dist[n], which is never
-    # written and stays INF (writing the in-range sink n instead would
-    # leak level values into later bottom-up hit tests)
-    flat = np.full(q_total * 8, n + 1, np.int32)
-    # positions of each edge in the 8-aligned layout: vertex v's edge k
-    # lands at colstart[v]*8 + k
-    starts8 = colstart[:n] * 8
-    pos = np.repeat(starts8 - indptr_out[:n], deg[:n]) \
-        + np.arange(len(dst_by_src), dtype=np.int64)
-    flat[pos] = dst_by_src
-    dstT = np.ascontiguousarray(flat.reshape(q_total, 8).T)
+    dstT, colstart, degc, q_total = chunked_layout(
+        dst_by_src, indptr_out, deg, n)
     # device-cost seam (obs/devprof): the chunked-CSR upload is the
     # dominant H2D cost of a cold snapshot — count it once per build
     from titan_tpu.obs import devprof
@@ -749,15 +761,27 @@ def _batched_plan():
         import jax
         import jax.numpy as jnp
 
-        @functools.partial(jax.jit, static_argnames=("c_cap", "n_"))
-        def bplan(dist, active, level, degc, c_cap: int, n_: int):
+        @functools.partial(jax.jit,
+                           static_argnames=("c_cap", "n_", "expand"))
+        def bplan(dist, active, level, degc, c_cap: int, n_: int,
+                  expand: bool = False):
             """ONE n-scale pass serving all K jobs: the per-job frontier
             counts (early-exit decisions), the SHARED candidate list
             (vertices unvisited in ANY active job, deg > 0 — one
             compaction amortized over K), and the per-job frontier
-            bitmaps for the bottom-up hit tests."""
+            bitmaps for the bottom-up hit tests.
+
+            ``expand`` (hops mode, olap/serving/interactive): every
+            vertex of an active job is a candidate every level — the
+            sweep computes the exact next-hop frontier SET instead of
+            BFS levels, so already-stamped vertices stay reachable
+            again at later hops."""
             fbits = _pack_bits_batched(dist, active, level, n_)
-            unvis = (dist[:, :n_] >= INF) & active[:, None]
+            if expand:
+                unvis = jnp.broadcast_to(active[:, None],
+                                         (dist.shape[0], n_))
+            else:
+                unvis = (dist[:, :n_] >= INF) & active[:, None]
             nf = ((dist[:, :n_] == level) & active[:, None]) \
                 .sum(axis=1).astype(jnp.int32)
             cand_mask = unvis.any(axis=0) & (degc[:n_] > 0)
@@ -774,11 +798,11 @@ def _batched_bu():
 
         @functools.partial(jax.jit,
                            static_argnames=("c_cap", "n_", "fuse",
-                                            "masked"),
+                                            "masked", "expand"),
                            donate_argnums=(0,))
         def bstep(dist, fbits, cand, off, prog, level, dstT, colstart,
                   degc, tbits, c_cap: int, n_: int, fuse: int,
-                  masked: bool = False):
+                  masked: bool = False, expand: bool = False):
             """``fuse`` chunk-check rounds over the shared candidate
             list: chunk ``off`` of each candidate is gathered ONCE and
             tested against all K bitmaps; per-job finds scatter into
@@ -787,9 +811,20 @@ def _batched_bu():
             ``tbits`` is the live overlay's tombstone bitmap over edge
             SLOTS (col*8 + lane): a tombstoned slot never counts as a
             parent — the expansion seam that keeps the base device CSR
-            valid under edge removals (olap/live)."""
+            valid under edge removals (olap/live).
+
+            ``expand`` (hops mode): no visited mask — every alive
+            candidate with a chunk neighbor in a job's frontier joins
+            that job's next hop, stamped ``level + 1`` via max-scatter
+            (monotone in level, so re-reached vertices re-stamp; the
+            0 scatter for misses is the max-identity no-op). A
+            candidate retires once every LIVE job (nonzero frontier
+            bitmap — deactivated/pad rows never hit and must not pin
+            candidates through all their chunks) has stamped it this
+            level."""
             c_count = prog[0]
             q_pad = dstT.shape[1] - 1
+            live = (fbits != 0).any(axis=1) if expand else None  # [K]
 
             def round_(state, _):
                 dist, cand, off, c_count = state
@@ -805,10 +840,16 @@ def _batched_bu():
                     slot = jnp.clip(cols, 0, q_pad)[None, :] * 8 + lane
                     hitl = hitl & ~_bit_of(tbits, slot)[None]
                 hit = hitl.any(axis=1)                     # [K, c_cap]
-                undec = dist[:, v] >= INF
-                found = undec & hit & alive[None, :]
-                dist = dist.at[:, jnp.where(alive, v, n_ + 1)].min(
-                    jnp.where(found, level + 1, INF), mode="drop")
+                if expand:
+                    undec = (dist[:, v] != level + 1) & live[:, None]
+                    found = undec & hit & alive[None, :]
+                    dist = dist.at[:, jnp.where(alive, v, n_ + 1)].max(
+                        jnp.where(found, level + 1, 0), mode="drop")
+                else:
+                    undec = dist[:, v] >= INF
+                    found = undec & hit & alive[None, :]
+                    dist = dist.at[:, jnp.where(alive, v, n_ + 1)].min(
+                        jnp.where(found, level + 1, INF), mode="drop")
                 rem = (undec & ~hit).any(axis=0)
                 surv = alive & rem & (off + 1 < degc[v])
                 nc = surv.sum().astype(jnp.int32)
@@ -834,11 +875,11 @@ def _batched_exhaust():
 
         @functools.partial(jax.jit,
                            static_argnames=("c_cap", "p_cap", "n_",
-                                            "masked"),
+                                            "masked", "expand"),
                            donate_argnums=(0,))
         def bex(dist, fbits, cand, off, prog, level, dstT, colstart,
                 degc, tbits, c_cap: int, p_cap: int, n_: int,
-                masked: bool = False):
+                masked: bool = False, expand: bool = False):
             """One masked sweep over ALL remaining chunks of the
             surviving candidates (hub stragglers), per-job any-hit via
             a shared owner scatter. ``masked``/``tbits``: tombstoned
@@ -861,6 +902,10 @@ def _batched_exhaust():
             own = jnp.where(j < p_total, owner, c_cap - 1)
             found_per = jnp.zeros((dist.shape[0], c_cap), jnp.int32) \
                 .at[:, own].max(hit.astype(jnp.int32), mode="drop")
+            if expand:
+                found = (found_per > 0) & valid[None, :]
+                return dist.at[:, jnp.where(valid, v, n_ + 1)].max(
+                    jnp.where(found, level + 1, 0), mode="drop")
             undec = dist[:, v] >= INF
             found = undec & (found_per > 0) & valid[None, :]
             dist = dist.at[:, jnp.where(valid, v, n_ + 1)].min(
@@ -876,18 +921,23 @@ def _overlay_scatter_batched():
         import jax.numpy as jnp
 
         @functools.partial(jax.jit,
-                           static_argnames=("cap", "n_"),
+                           static_argnames=("cap", "n_", "expand"),
                            donate_argnums=(0,))
         def oscat(dist, fbits, ov_src, ov_dst, level, cap: int,
-                  n_: int):
+                  n_: int, expand: bool = False):
             """Delta-COO expansion pass: for every live overlay edge
             (u, v), jobs whose frontier bitmap holds u scatter
             level+1 into v — the add-edge half of the overlay seam
             (tombstones mask the base pull; this pushes the adds).
             Pad entries (n+1) miss every bitmap and drop from the
             scatter; min keeps earlier levels, so the pass composes
-            with the base sweep in any order."""
+            with the base sweep in any order. ``expand`` (hops mode):
+            max-scatter of the hop stamp instead — same monotone
+            re-stamp contract as the base sweep."""
             hit = _bit_of_batched(fbits, ov_src)          # [K, cap]
+            if expand:
+                return dist.at[:, ov_dst].max(
+                    jnp.where(hit, level + 1, 0), mode="drop")
             msg = jnp.where(hit, level + 1, INF)
             return dist.at[:, ov_dst].min(msg, mode="drop")
         return oscat
@@ -897,7 +947,8 @@ def _overlay_scatter_batched():
 def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
                          on_level=None, return_device: bool = False,
                          init_dist=None, start_level: int = 0,
-                         checkpoint=None, overlay=None):
+                         checkpoint=None, overlay=None,
+                         mode: str = "bfs"):
     """Batched multi-source BFS: run K BFS jobs over the SAME graph as
     one device run with [K, n] state. Each job's ``dist`` row is
     bit-equal to ``frontier_bfs_hybrid`` from that source (BFS distances
@@ -926,6 +977,20 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
     freshly rebuilt snapshot (BFS levels are canonical) while the base
     device CSR stays resident and untouched.
 
+    Hops mode (``mode="hops"`` — the interactive traversal lane,
+    olap/serving/interactive): the SAME shared plan/sweep machinery
+    computes exact per-hop frontier SETS instead of BFS levels — no
+    visited mask, so a vertex reached at hop h is reached AGAIN at hop
+    h' > h when a path exists (Gremlin ``out()*h`` set semantics,
+    which BFS levels cannot express). Encoding: dist[k, v] = the LAST
+    loop level at which v was in job k's frontier (max-scatter of
+    ``level + 1``; 0 = never reached), so the hop-d frontier of a job
+    deactivated after its own depth via the ``on_level`` keep mask is
+    exactly ``dist == d + start_level``. Requires ``start_level >= 1``
+    (0 is the never-reached background) and seeds stamped
+    ``start_level`` in ``init_dist`` (or via ``sources`` when
+    ``init_dist`` is None — multi-source rows seed through init_dist).
+
     Returns ``(dist, levels, completed)``: dist [K, n] (device array
     when ``return_device``, else numpy; INF = unreachable — partial for
     non-completed jobs), levels np int32 [K] (the level at which each
@@ -946,6 +1011,12 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
     tbits = ov.tomb_dev if masked else jnp.zeros((1,), jnp.uint8)
     oscat = _overlay_scatter_batched() if ov is not None \
         and ov.count > 0 else None
+    if mode not in ("bfs", "hops"):
+        raise ValueError(f"mode must be 'bfs' or 'hops', got {mode!r}")
+    expand = mode == "hops"
+    if expand and start_level < 1:
+        raise ValueError("hops mode needs start_level >= 1 (0 is the "
+                         "never-reached background value)")
     K = len(sources)
     if K == 0:
         raise ValueError("frontier_bfs_batched needs >= 1 source")
@@ -965,7 +1036,16 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
                 [a, jnp.full((cap_n - a.shape[0],), n + 1, a.dtype)])
         return a
 
-    if init_dist is None:
+    if init_dist is None and expand:
+        # hops-mode default seeding: one start vertex per job stamped
+        # at start_level over a zero background (multi-source rows go
+        # through init_dist)
+        dist = jnp.zeros((K, n + 1), jnp.int32) \
+            .at[jnp.arange(K),
+                jnp.asarray(src_arr.astype(np.int32))] \
+            .set(start_level) \
+            .at[:, n].set(INF)
+    elif init_dist is None:
         dist = jnp.full((K, n + 1), INF, jnp.int32) \
             .at[jnp.arange(K),
                 jnp.asarray(src_arr.astype(np.int32))].set(0)
@@ -985,7 +1065,7 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
     level = int(start_level)
     while level < max_levels:
         fbits, cand, stats = bplan(dist, active, dev_scalar(level), degc,
-                                   c_cap=cap_n, n_=n)
+                                   c_cap=cap_n, n_=n, expand=expand)
         st = np.asarray(stats)          # ONE sync per level for ALL jobs
         nf = st[1:]
         mask_changed = False
@@ -1019,7 +1099,8 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
             # candidates to every remaining level)
             active = jnp.asarray(act_h)
             fbits, cand, stats = bplan(dist, active, dev_scalar(level),
-                                       degc, c_cap=cap_n, n_=n)
+                                       degc, c_cap=cap_n, n_=n,
+                                       expand=expand)
             st = np.asarray(stats)
         if oscat is not None:
             # overlay add-edges expand top-down off the level's final
@@ -1028,7 +1109,8 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
             # it must run even when the base candidate list is empty
             # (vertices reachable only through overlay edges)
             dist = oscat(dist, fbits, ov.src_dev, ov.dst_dev,
-                         dev_scalar(level), cap=ov.cap, n_=n)
+                         dev_scalar(level), cap=ov.cap, n_=n,
+                         expand=expand)
         c_count = int(st[0])
         # chunk rounds over the shared candidate list (bu_more shape)
         off = None
@@ -1044,7 +1126,8 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
             dist, cand, off, prog = bstep(
                 dist, fbits, cand[:c_cap2], off[:c_cap2], prog,
                 dev_scalar(level), dstT, colstart, degc, tbits,
-                c_cap=c_cap2, n_=n, fuse=fuse, masked=masked)
+                c_cap=c_cap2, n_=n, fuse=fuse, masked=masked,
+                expand=expand)
             cand, off = pad(cand), pad(off)
             c_count, rem8 = (int(x) for x in np.asarray(prog))
             rounds += fuse
@@ -1053,7 +1136,8 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
             rem_cap = _next_pow2(max(rem8, 2))
             dist = bex(dist, fbits, cand[:c_cap2], off[:c_cap2], prog,
                        dev_scalar(level), dstT, colstart, degc, tbits,
-                       c_cap=c_cap2, p_cap=rem_cap, n_=n, masked=masked)
+                       c_cap=c_cap2, p_cap=rem_cap, n_=n, masked=masked,
+                       expand=expand)
         level += 1
     # jobs still active at max_levels count as completed-at-cap
     if act_h.any():

@@ -666,7 +666,7 @@ def pagerank_dense(snap_or_graph, iterations: int = 20,
                    damping: float = 0.85, tol: float | None = None,
                    return_device: bool = False, on_round=None,
                    checkpoint=None, resume: dict | None = None,
-                   overlay=None):
+                   overlay=None, reset=None):
     """Push-mode PageRank over the chunked CSR via dense window sweeps:
     rank' = (1-d)/n + d * sum over in-edges of rank[src]/outdeg[src]
     (semantics match the pull-mode engine program in models/pagerank.py,
@@ -680,7 +680,16 @@ def pagerank_dense(snap_or_graph, iterations: int = 20,
     iteration ``it`` (rank [n+1] device). ``resume``: ``{"rank", "it"}``
     — continue from iteration ``it``; ``contrib`` is a pure elementwise
     function of rank (same IEEE expressions as the in-loop recompute),
-    so the continuation is bit-equal to an uninterrupted run."""
+    so the continuation is bit-equal to an uninterrupted run.
+
+    ``reset`` ([n] float, sums to 1): PERSONALIZED PageRank — the
+    teleport distribution becomes ``(1-d) * reset`` (a one-hot row =
+    one user's random walk with restart) and the initial rank IS the
+    reset vector. ``None`` keeps the uniform formulation above,
+    bit-identical to the pre-personalization kernel (it runs the same
+    jit cache entries). This is the sequential oracle
+    ``models/pagerank.pagerank_personalized_batched`` is pinned
+    bit-equal to, per source row."""
     import jax.numpy as jnp
 
     # an explicitly passed view (the serving lease's, frozen at the
@@ -708,11 +717,20 @@ def pagerank_dense(snap_or_graph, iterations: int = 20,
     total = g["q_total"]
     W = min(DENSE_WINDOW, total)
     win = _pr_window()
-    fin = _pr_finish()
+    reset_dev = None
+    if reset is not None:
+        r = jnp.asarray(reset, jnp.float32)
+        if r.shape != (n,):
+            raise ValueError(f"reset must be [n={n}], got {r.shape}")
+        reset_dev = jnp.concatenate(
+            [r, jnp.zeros((1,), jnp.float32)])
+    fin = _pr_finish() if reset_dev is None else _pr_finish_reset()
     it0 = 0
     if resume is not None:
         rank = jnp.asarray(resume["rank"], jnp.float32)
         it0 = int(resume["it"])
+    elif reset_dev is not None:
+        rank = reset_dev
     else:
         rank = jnp.full((n + 1,), 1.0 / n, jnp.float32) \
             .at[n].set(0.0)
@@ -726,8 +744,12 @@ def pagerank_dense(snap_or_graph, iterations: int = 20,
             # pooled window starts: a fresh scalar put per window costs
             # a tunnel round trip (64 windows/iteration at scale 26)
             acc = win(acc, contrib, dev_scalar(w0), dstT, colowner, W=W)
-        rank, contrib, delta = fin(acc, rank, deg,
-                                   jnp.float32(damping), n_=n)
+        if reset_dev is None:
+            rank, contrib, delta = fin(acc, rank, deg,
+                                       jnp.float32(damping), n_=n)
+        else:
+            rank, contrib, delta = fin(acc, rank, reset_dev, deg,
+                                       jnp.float32(damping), n_=n)
         if checkpoint is not None:
             checkpoint(it, {"rank": rank})
         if tol is not None and float(delta) < tol:
@@ -775,6 +797,29 @@ def _pr_finish():
             return new_rank, contrib, delta
         return fin
     return jit_once("pagerank_finish", build)
+
+
+def _pr_finish_reset():
+    """Personalized finish: teleport mass lands on the ``reset``
+    distribution instead of uniformly — its own jit entry so the
+    uniform path keeps its exact pre-personalization cache key and
+    HLO. The per-row expressions here must stay IDENTICAL to the
+    vmapped batched kernel in models/pagerank.py (bit-equality per
+    source is the contract)."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_",))
+        def fin(acc, rank, reset, deg, damping, n_: int):
+            new_rank = (1.0 - damping) * reset[:n_] + damping * acc[:n_]
+            new_rank = jnp.concatenate(
+                [new_rank, jnp.zeros((1,), jnp.float32)])
+            delta = jnp.abs(new_rank[:n_] - rank[:n_]).sum()
+            contrib = jnp.where(deg > 0, new_rank / jnp.maximum(deg, 1), 0.0)
+            return new_rank, contrib, delta
+        return fin
+    return jit_once("pagerank_finish_reset", build)
 
 
 def frontier_wcc(snap_or_graph, max_rounds: int = 10_000,

@@ -11,6 +11,7 @@ TPU formulation is the classic pull-mode SpMV:
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from titan_tpu.olap.api import DenseMapReduce, DenseProgram
 
@@ -68,6 +69,178 @@ class TopRanksMapReduce(DenseMapReduce):
         vals = np.asarray(vals)
         vids = np.asarray(snapshot.vertex_ids)[idx]
         return [(int(v), float(r)) for v, r in zip(vids, vals)]
+
+
+def _ppr_window_batched():
+    """[S, n+1] window sweep: jax.vmap of the EXACT per-row expressions
+    of ``frontier._pr_window`` — one shared dstT/colowner gather plan
+    serves every source row (the K-way amortization story, applied to
+    the recommendation workload)."""
+    def build():
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("W",),
+                           donate_argnums=(0,))
+        def step(acc, contrib, w0, dstT, colowner, W: int):
+            def one(acc_r, contrib_r):
+                w0c = jnp.minimum(w0, colowner.shape[0] - W)
+                owner = jax.lax.dynamic_slice(colowner, (w0c,), (W,))
+                nbr = jax.lax.dynamic_slice(dstT, (0, w0c), (8, W))
+                fresh = (w0c + jnp.arange(W, dtype=jnp.int32)) >= w0
+                c = jnp.where(fresh, contrib_r[owner], 0.0)
+                return acc_r.at[nbr].add(
+                    jnp.broadcast_to(c[None, :], nbr.shape),
+                    mode="drop")
+            return jax.vmap(one)(acc, contrib)
+        return step
+    from titan_tpu.utils.jitcache import jit_once
+    return jit_once("ppr_window_batched", build)
+
+
+def _ppr_finish_batched():
+    """[S, n+1] finish: jax.vmap of ``frontier._pr_finish_reset``'s
+    per-row expressions (bit-equality per source rides on the two
+    staying identical)."""
+    def build():
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_",))
+        def fin(acc, rank, reset, deg, damping, n_: int):
+            def one(acc_r, rank_r, reset_r):
+                new_rank = (1.0 - damping) * reset_r[:n_] \
+                    + damping * acc_r[:n_]
+                new_rank = jnp.concatenate(
+                    [new_rank, jnp.zeros((1,), jnp.float32)])
+                delta = jnp.abs(new_rank[:n_] - rank_r[:n_]).sum()
+                contrib = jnp.where(deg > 0,
+                                    new_rank / jnp.maximum(deg, 1), 0.0)
+                return new_rank, contrib, delta
+            return jax.vmap(one)(acc, rank, reset)
+        return fin
+    from titan_tpu.utils.jitcache import jit_once
+    return jit_once("ppr_finish_batched", build)
+
+
+def pagerank_personalized_batched(snap_or_graph, sources=None,
+                                  iterations: int = 20,
+                                  damping: float = 0.85,
+                                  reset=None,
+                                  return_device: bool = False,
+                                  on_round=None, overlay=None):
+    """Batched personalized PageRank: one RESET ROW PER USER, vmapped
+    over the dense window kernel — S users' recommendation walks run as
+    ONE device dispatch sharing every edge gather (the interactive
+    lane's flagship workload, olap/serving/interactive).
+
+    ``sources``: dense vertex indices; row s teleports (and starts) at
+    the one-hot distribution of ``sources[s]``. ``reset`` ([S, n],
+    rows summing to 1) overrides with arbitrary per-user teleport
+    distributions. Each row is BIT-EQUAL to a sequential
+    ``frontier.pagerank_dense(snap, reset=row)`` run — the oracle the
+    property tests pin.
+
+    ``on_round(it)``: per-iteration veto (RoundInterrupted), same
+    contract as pagerank_dense. No per-source ``tol`` early exit: the
+    shared loop runs the full iteration budget (a per-row tol would
+    desynchronize the fused rows). Returns ``(ranks [S, n], iters)``.
+    """
+    import jax.numpy as jnp
+
+    from titan_tpu.models.bfs_hybrid import build_chunked_csr
+    from titan_tpu.models.frontier import (DENSE_WINDOW, RoundInterrupted,
+                                           _colowner)
+    from titan_tpu.utils.jitcache import dev_scalar
+
+    ov = overlay
+    if ov is None and not isinstance(snap_or_graph, dict):
+        ov = getattr(snap_or_graph, "_live_overlay", None)
+    if ov is not None and not ov.empty:
+        # same seam as pagerank_dense: dense window sweeps read
+        # contiguous base-CSR columns — compact the overlay first (the
+        # interactive lane leases compacted=True for this kind)
+        raise RuntimeError(
+            "pagerank_personalized_batched on a live overlay: compact "
+            "the overlay first (LiveGraphPlane.compact_if_dirty) — "
+            "dense window sweeps have no overlay seam")
+    g = snap_or_graph if isinstance(snap_or_graph, dict) \
+        else build_chunked_csr(snap_or_graph)
+    n = g["n"]
+    deg = g["deg"].astype(jnp.float32)
+    colowner = _colowner(g)
+    total = g["q_total"]
+    W = min(DENSE_WINDOW, total)
+    if reset is not None:
+        r = jnp.asarray(reset, jnp.float32)
+        if r.ndim != 2 or r.shape[1] != n:
+            raise ValueError(f"reset must be [S, n={n}], got {r.shape}")
+        S = r.shape[0]
+        reset_dev = jnp.concatenate(
+            [r, jnp.zeros((S, 1), jnp.float32)], axis=1)
+    else:
+        if sources is None or len(sources) == 0:
+            raise ValueError("need sources (dense indices) or reset "
+                             "rows — one per user")
+        src = np.asarray(sources, np.int64)
+        if src.min() < 0 or src.max() >= n:
+            raise IndexError(f"source out of range [0, {n})")
+        S = len(src)
+        reset_dev = jnp.zeros((S, n + 1), jnp.float32) \
+            .at[jnp.arange(S), jnp.asarray(src.astype(np.int32))] \
+            .set(1.0)
+    win = _ppr_window_batched()
+    fin = _ppr_finish_batched()
+    rank = reset_dev
+    contrib = jnp.where(deg > 0, rank / jnp.maximum(deg, 1.0), 0.0)
+    it = 0
+    for it in range(1, iterations + 1):
+        if on_round is not None and not on_round(it - 1):
+            raise RoundInterrupted(it - 1)
+        acc = jnp.zeros((S, n + 1), jnp.float32)
+        for w0 in range(0, total, W):
+            acc = win(acc, contrib, dev_scalar(w0), g["dstT"],
+                      colowner, W=W)
+        rank, contrib, _delta = fin(acc, rank, reset_dev, deg,
+                                    jnp.float32(damping), n_=n)
+    out = rank[:, :n]
+    if not return_device:
+        from titan_tpu.obs import devprof
+        devprof.count_d2h("frontier.result",
+                          getattr(out, "nbytes", 0))
+        out = np.asarray(out)
+    return out, it
+
+
+def top_k_per_user(ranks, vertex_ids, k: int = 10,
+                   exclude=None):
+    """Per-user top-k ``(vertex id, rank)`` recommendation rows from a
+    batched PPR result ([S, n] host array). ``exclude`` (optional
+    [S]-list of dense indices, typically each user's own source) zeroes
+    the user's self-rank before ranking — a recommender never
+    recommends the user to themselves."""
+    ranks = np.asarray(ranks)
+    S, n = ranks.shape
+    k = min(int(k), n)
+    if k <= 0:
+        # a non-positive k must answer "no recommendations", never the
+        # negative-slice near-whole-graph argpartition surprise
+        return [[] for _ in range(S)]
+    out = []
+    for s in range(S):
+        row = ranks[s]
+        if exclude is not None and exclude[s] is not None:
+            row = row.copy()
+            row[exclude[s]] = -1.0
+        idx = np.argpartition(-row, k - 1)[:k]
+        idx = idx[np.argsort(-row[idx], kind="stable")]
+        out.append([(int(vertex_ids[i]), float(ranks[s][i]))
+                    for i in idx if row[i] > 0.0])
+    return out
 
 
 def run(computer, alpha: float = 0.85, iterations: int = 20, tol: float = 0.0,

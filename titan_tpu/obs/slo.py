@@ -62,7 +62,12 @@ _BAD_STATES = ("failed", "timeout")
 class SLO:
     """One objective: exactly ONE of ``p95_ms`` / ``success_rate``.
     ``tenant`` / ``algorithm`` (job kind) select the labeled metric
-    children the SLI is computed from; both unset = the whole plane."""
+    children the SLI is computed from; both unset = the whole plane.
+
+    ``metric`` (p95 objectives only): the latency histogram the SLI
+    reads — default ``serving.job.latency_ms`` (the heavy OLAP queue);
+    the interactive lane's p95 objective points it at
+    ``serving.interactive.latency_ms`` (ISSUE 11)."""
 
     name: str
     tenant: Optional[str] = None
@@ -70,6 +75,7 @@ class SLO:
     p95_ms: Optional[float] = None
     success_rate: Optional[float] = None
     windows: tuple = DEFAULT_WINDOWS
+    metric: Optional[str] = None
 
     def __post_init__(self):
         if (self.p95_ms is None) == (self.success_rate is None):
@@ -80,6 +86,10 @@ class SLO:
                 and not 0.0 < self.success_rate < 1.0:
             raise ValueError(f"SLO {self.name!r}: success_rate must be "
                              f"in (0, 1), got {self.success_rate}")
+        if self.metric is not None and self.p95_ms is None:
+            raise ValueError(
+                f"SLO {self.name!r}: metric= selects a latency "
+                "histogram, which only a p95_ms objective reads")
         if not self.windows:
             raise ValueError(f"SLO {self.name!r}: needs >= 1 window")
 
@@ -139,9 +149,12 @@ class SLOEngine:
                   for s in _BAD_STATES)
         return good + bad, float(bad)
 
+    def _latency_metric(self, slo: SLO) -> str:
+        return slo.metric or self.LATENCY_METRIC
+
     def _latency_counts(self, slo: SLO) -> tuple:
         total, bad = 0, 0.0
-        for _lbls, h in self.metrics.children(self.LATENCY_METRIC,
+        for _lbls, h in self.metrics.children(self._latency_metric(slo),
                                               slo.selector):
             total += h.count
             samples = h.values()
@@ -159,7 +172,7 @@ class SLOEngine:
         no data = within objective (an idle tenant is not in breach)."""
         if slo.p95_ms is not None:
             pooled: list = []
-            for _lbls, h in self.metrics.children(self.LATENCY_METRIC,
+            for _lbls, h in self.metrics.children(self._latency_metric(slo),
                                                   slo.selector):
                 pooled.extend(h.values())
             if not pooled:
@@ -220,7 +233,9 @@ class SLOEngine:
                     windows[_window_key(w)] = {
                         "burn_rate": round(burn, 6),
                         "events": d_total, "bad": round(d_bad, 6)}
-                objective = {"p95_ms": o.p95_ms} \
+                objective = {"p95_ms": o.p95_ms,
+                             **({"metric": o.metric}
+                                if o.metric is not None else {})} \
                     if o.p95_ms is not None \
                     else {"success_rate": o.success_rate}
                 slos.append({"slo": o.name, "tenant": o.tenant,

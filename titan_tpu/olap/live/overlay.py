@@ -199,14 +199,22 @@ class DeltaOverlay:
         if self.snap.labels is None:
             return None
         if self._labels_by_src is None:
-            order = np.argsort(self.snap.src, kind="stable")
-            self._labels_by_src = self.snap.labels[order]
-            self._order = order
+            self._labels_by_src = self.snap.labels[self._base_order()]
         return self._labels_by_src
 
     def _base_order(self) -> np.ndarray:
+        """src-order permutation of the base rows (slot → dst-order
+        row). The snapshot caches it beside its out-CSR — ``__init__``
+        already forced that build — and ``merge_delta`` carries both
+        across epoch merges incrementally, so this is a read, not an
+        O(E log E) argsort re-paid per epoch (ROADMAP #5 residual)."""
         if getattr(self, "_order", None) is None:
-            self._order = np.argsort(self.snap.src, kind="stable")
+            order = getattr(self.snap, "_out_csr_order", None)
+            if order is None:
+                self.snap.out_csr()
+                order = getattr(self.snap, "_out_csr_order", None)
+            self._order = order if order is not None \
+                else np.argsort(self.snap.src, kind="stable")
         return self._order
 
     def remove_edge(self, u: int, v: int, lab: Optional[int]) -> bool:

@@ -1,0 +1,26 @@
+"""Interactive traversal lane: OLTP-shaped point reads on the OLAP plane.
+
+Millions of users asking ``g.V(x).out().out()``-class questions get a
+dedicated sub-millisecond lane (ROADMAP #3): bounded-depth dsl chains
+compile onto the batched ``[K, n]`` frontier machinery
+(``compile.py`` → ``models/bfs_hybrid.frontier_bfs_batched``
+``mode="hops"``), a deadline-driven micro-batcher fuses concurrent
+point queries into one device dispatch (``collector.py``), and a
+low-latency lane bypasses the heavy OLAP queue while flowing through
+tenant quotas, tracing and the device-cost profiler
+(``scheduler.py``). Batched personalized PageRank
+(``models/pagerank.pagerank_personalized_batched``) rides the same
+lane as the flagship recommendation workload. Wire surface: ``POST
+/traverse`` (server.py); metrics: ``serving.interactive.*``
+(docs/monitoring.md); unsupported chains fall back LOUDLY to the
+``traversal/dsl.py`` interpreter.
+"""
+
+from titan_tpu.olap.serving.interactive.collector import (  # noqa: F401
+    Collector, InteractiveRequest)
+from titan_tpu.olap.serving.interactive.compile import (  # noqa: F401
+    DEFAULT_MAX_DEPTH, FallbackToInterpreter, PPRPlan, TraversalPlan,
+    compile_steps, compile_traversal, plan_from_wire,
+    traversal_from_plan)
+from titan_tpu.olap.serving.interactive.scheduler import (  # noqa: F401
+    InteractiveLane)

@@ -121,7 +121,10 @@ class JobScheduler:
                  profiling: Optional[bool] = None,
                  profiler=None,
                  flight_dir: Optional[str] = None,
-                 flight_capacity: int = 4096):
+                 flight_capacity: int = 4096,
+                 interactive_window_s: Optional[float] = None,
+                 interactive_max_fuse: Optional[int] = None,
+                 interactive_max_depth: Optional[int] = None):
         # observability plane (titan_tpu/obs): one tracer per scheduler,
         # one trace per job (trace id == job id) — submit/queue/attempt
         # spans here, fuse/run/round/checkpoint spans in the batcher &
@@ -240,6 +243,16 @@ class JobScheduler:
             self.ckpt_store = CheckpointStore(checkpoint_dir,
                                               metrics=self._metrics)
             self._ckpt_ns = uuid.uuid4().hex[:12]
+        # interactive lane (olap/serving/interactive, ISSUE 11):
+        # constructed lazily on the first point query — the fuse
+        # window / occupancy / depth ceiling are scheduler config so a
+        # server-injected scheduler pins batching for tests
+        self._interactive = None
+        self._interactive_cfg = {
+            k: v for k, v in (("window_s", interactive_window_s),
+                              ("max_fuse", interactive_max_fuse),
+                              ("max_depth", interactive_max_depth))
+            if v is not None}
         self._jobs: dict[str, Job] = {}
         self._heap: list = []
         self._seq = itertools.count()
@@ -267,10 +280,31 @@ class JobScheduler:
             self._worker.start()
         return self
 
+    def interactive(self):
+        """The scheduler's interactive point-query lane
+        (olap/serving/interactive.InteractiveLane), created on first
+        use — ``POST /traverse``'s executor. Shares this scheduler's
+        pool, ledger, tenant quotas, tracer and profiler."""
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("scheduler is closed")
+            if self._interactive is None or self._interactive.closed:
+                from titan_tpu.olap.serving.interactive import \
+                    InteractiveLane
+                self._interactive = InteractiveLane(
+                    self, **self._interactive_cfg)
+            return self._interactive
+
     def close(self, timeout: float = 10.0) -> None:
         with self._cv:
+            # under the cv: interactive() creates the lane under this
+            # same lock and refuses once _stop is set, so no lane can
+            # be constructed after this read and escape the close
             self._stop = True
+            lane = self._interactive
             self._cv.notify_all()
+        if lane is not None:
+            lane.close()
         if self._worker is not None:
             self._worker.join(timeout)
         # queued jobs fail loudly rather than hang their waiters
@@ -298,18 +332,28 @@ class JobScheduler:
 
     def _evict(self, key) -> None:
         """HBM eviction: drop the snapshot's cached device CSR (arrays
-        free when the last jax reference dies)."""
+        free when the last jax reference dies). An ``(obj, attr)``
+        entry drops that attribute instead — the interactive lane's
+        reversed-orientation layout registers itself this way."""
         snap = self._evictable.pop(key, None)
-        if snap is not None and hasattr(snap, "_hybrid_csr"):
+        if isinstance(snap, tuple):
+            obj, attr = snap
+            if hasattr(obj, attr):
+                delattr(obj, attr)
+        elif snap is not None and hasattr(snap, "_hybrid_csr"):
             delattr(snap, "_hybrid_csr")
 
     def _forget_snapshot(self, snap) -> None:
         """Pool close hook: a retired/rebuilt snapshot leaves the HBM
         ledger (and the evictable map) instead of counting as resident
-        forever."""
+        forever — including the interactive lane's reversed-orientation
+        layout riding on the same snapshot."""
         key = id(snap)
         self._evictable.pop(key, None)
         self.ledger.release(key)
+        rev_key = ("interactive-rev", key)
+        self._evictable.pop(rev_key, None)
+        self.ledger.release(rev_key)
 
     # -- submission surface --------------------------------------------------
 

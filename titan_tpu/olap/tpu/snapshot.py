@@ -341,7 +341,8 @@ class GraphSnapshot:
         The dense vertex-property columns are NOT cleared here — they
         stay aligned across edge-only merges; apply_changes clears them
         on property mutations (by key) and vertex-set changes (all)."""
-        for attr in ("_out_csr", "_hybrid_csr", "_frontier_shards",
+        for attr in ("_out_csr", "_out_csr_order", "_hybrid_csr",
+                     "_hybrid_csr_rev", "_frontier_shards",
                      "_dev_frontier_sh", "_tiled_shards", "_dev_outdeg",
                      "_dev_frontier"):
             if hasattr(self, attr):
@@ -398,7 +399,11 @@ class GraphSnapshot:
     def out_csr(self) -> tuple[np.ndarray, np.ndarray]:
         """(dst_by_src, indptr_out): edges sorted by SOURCE — the push/
         expansion layout used by frontier-sparse traversal. Computed once
-        and cached (the snapshot is immutable)."""
+        and cached (the snapshot is immutable). The src-order
+        permutation itself is kept as ``_out_csr_order`` (src-order
+        position → dst-order row): the live overlay's slot-lookup index
+        reads it instead of re-paying the argsort, and ``merge_delta``
+        carries both caches across an epoch merge incrementally."""
         cached = getattr(self, "_out_csr", None)
         if cached is None:
             # indptr is just the cumsum of the existing out_degree; the sort
@@ -415,6 +420,7 @@ class GraphSnapshot:
                 dst_by_src = self.dst[order]
             cached = (dst_by_src, indptr_out)
             self._out_csr = cached
+            self._out_csr_order = np.asarray(order, np.int64)
         return cached
 
     def reverse(self) -> "GraphSnapshot":
@@ -499,8 +505,80 @@ def merge_delta(snap: GraphSnapshot, keep: np.ndarray, add_src,
         np.add.at(out_degree, dead_src, -1)
     if len(a_s):
         np.add.at(out_degree, a_s.astype(np.int64), 1)
-    return GraphSnapshot(snap.n, snap.vertex_ids, src, dst, indptr_in,
-                         out_degree, {}, labels, dict(snap.label_names))
+    merged = GraphSnapshot(snap.n, snap.vertex_ids, src, dst, indptr_in,
+                           out_degree, {}, labels,
+                           dict(snap.label_names))
+    # ROADMAP #5 residual (ISSUE 11 satellite): the merged epoch's
+    # out-CSR — and the src-order permutation the next overlay's
+    # slot-lookup index is built from — carry over INCREMENTALLY when
+    # the base had them cached (the overlay's own construction always
+    # does), so the next DeltaOverlay never re-pays the O(E log E)
+    # argsort the device merge path already eliminated everywhere else
+    if getattr(snap, "_out_csr", None) is not None \
+            and getattr(snap, "_out_csr_order", None) is not None:
+        _merge_out_csr(snap, merged, keep, add_src, add_dst, pos)
+    return merged
+
+
+def _merge_out_csr(snap: GraphSnapshot, merged: GraphSnapshot,
+                   keep: np.ndarray, add_src: np.ndarray,
+                   add_dst: np.ndarray, pos_d: np.ndarray) -> None:
+    """Incremental src-sorted layout across ``merge_delta``: build the
+    merged snapshot's ``_out_csr`` (dst_by_src, indptr_out) and
+    ``_out_csr_order`` from the base's cached pair — O(E) gathers +
+    O(delta log delta) sorts, bit-equal to a from-scratch
+    ``out_csr()`` on the merged arrays (pinned by
+    tests/test_live_compact_device.py).
+
+    Correctness: a stable src-sort preserves dst order within each
+    source group (the merged array is dst-ascending), kept rows keep
+    their relative order under row drops, and equal-(src, dst) adds
+    land AFTER kept rows in append order — exactly a ``side='right'``
+    insert on the (src, dst) composite key. ``pos_d`` is the dst-order
+    insert-position vector ``merge_delta`` already computed (the adds'
+    merged-row indices are ``pos_d + arange``)."""
+    dst_by_src_old, _ = snap._out_csr
+    order_old = snap._out_csr_order
+    n = snap.n
+    keep_s = keep[order_old]                  # keep mask, src order
+    kept_dst_s = dst_by_src_old[keep_s]
+    # src values in src order are just each vertex id repeated by its
+    # OLD out-degree — no sort needed
+    src_sorted_old = np.repeat(np.arange(n, dtype=np.int64),
+                               snap.out_degree.astype(np.int64))
+    kept_src_s = src_sorted_old[keep_s]
+    # adds in (src, dst, append) order: stable dst-sort then stable
+    # src-sort composes to exactly that
+    o1 = np.argsort(add_dst, kind="stable")
+    o = o1[np.argsort(add_src[o1], kind="stable")]
+    as_s, ad_s = add_src[o].astype(np.int64), add_dst[o]
+    # composite (src, dst) key: kept rows are sorted under it (groups
+    # ascend by src, dst ascends within each group)
+    key_kept = kept_src_s * np.int64(n + 1) + kept_dst_s
+    key_add = as_s * np.int64(n + 1) + ad_s
+    pos_s = np.searchsorted(key_kept, key_add, side="right")
+    dst_by_src_new = np.insert(kept_dst_s, pos_s, ad_s)
+    indptr_out_new = np.concatenate(
+        [np.zeros(1, np.int64),
+         np.cumsum(merged.out_degree, dtype=np.int64)])
+    # merged-array row index per src-order position: kept row j (in
+    # kept-dst order) shifts by the adds inserted at/before it;
+    # dst-order add k lands at pos_d[k] + k
+    kept_rank = np.cumsum(keep, dtype=np.int64) - 1
+    j_kept = kept_rank[order_old[keep_s]]
+    merged_idx_kept = j_kept + np.searchsorted(pos_d, j_kept,
+                                               side="right")
+    merged_idx_add_d = pos_d.astype(np.int64) \
+        + np.arange(len(pos_d), dtype=np.int64)
+    # map each ORIGINAL add row to its dst-order rank, then read its
+    # merged index in the src-sorted visit order
+    ord_d = np.argsort(add_dst, kind="stable")
+    rank_d = np.empty(len(ord_d), np.int64)
+    rank_d[ord_d] = np.arange(len(ord_d), dtype=np.int64)
+    merged_idx_add_s = merged_idx_add_d[rank_d[o]]
+    order_new = np.insert(merged_idx_kept, pos_s, merged_idx_add_s)
+    merged._out_csr = (dst_by_src_new, indptr_out_new)
+    merged._out_csr_order = order_new
 
 
 def _scan_python(graph, rows, exists_q, scan_q, label_ids, key_ids):

@@ -15,6 +15,32 @@ Endpoints:
                       evaluated against bindings {g, P, graph}; like Gremlin
                       Server's script engine, the endpoint executes caller
                       scripts — deploy it only where the caller is trusted.
+  POST /traverse    — the interactive point-query lane (ISSUE 11,
+                      olap/serving/interactive): bounded-depth
+                      traversals compiled onto the batched [K, n]
+                      frontier kernels — concurrent calls of
+                      compatible shape FUSE into one device dispatch
+                      inside a few-ms window. Body (structured):
+                      {"start": [vertex ids], "dir": "out|in|both",
+                       "hops": 2, "labels": [...],
+                       "terminal": "id" | "count" | {"values": key},
+                       "tenant": "team-a"}
+                      or {"gremlin": "g.V(5).out().dedup().id_()"} —
+                      a dsl chain, compiled when inside the supported
+                      subset, LOUDLY interpreter-executed otherwise
+                      (serving.interactive.fallbacks; response carries
+                      "fallback": true). Personalized PageRank rides
+                      the same lane: {"kind": "ppr", "source": id,
+                      "iterations": 20, "damping": 0.85, "top_k": 10}
+                      → per-user [vertex id, rank] recommendations out
+                      of one batched [S, n] vmapped run. Responses
+                      carry the fuse evidence (batch id, fused_k,
+                      wait_ms/exec_ms) and the lease epoch; an
+                      enforced tenant-quota violation is 429 +
+                      retryable. Metrics: serving.interactive.*
+                      (docs/monitoring.md); p95 SLO via
+                      obs.slo.SLO(metric=
+                      "serving.interactive.latency_ms").
   POST   /jobs      — submit an async OLAP job (olap/serving): body
                       {"kind": "bfs", "source": <vertex id>, ...,
                        "priority": 0, "timeout_s": 30, "deadline_s": 60,
@@ -295,6 +321,91 @@ class GraphServer:
                        tenant=body.get("tenant"))
         return self.scheduler().submit(spec)
 
+    # -- interactive point-query lane (olap/serving/interactive) -------------
+
+    def _script_traversal(self, script: str):
+        """Evaluate a gremlin script to a LAZY dsl Traversal (no
+        execution, no transaction side effects — building a chain only
+        appends steps)."""
+        from titan_tpu.query.predicates import P
+        from titan_tpu.traversal import dsl as _dsl
+        from titan_tpu.traversal.dsl import Traversal
+        bindings = {"g": self.graph.traversal(), "P": P,
+                    "anon": _dsl.anon, "__": getattr(_dsl, "__"),
+                    "__builtins__": {}}
+        t = eval(script, bindings)  # noqa: S307 — same trust model as
+        #                             POST /traversal (script endpoint)
+        if not isinstance(t, Traversal):
+            raise ValueError("'gremlin' must evaluate to a traversal "
+                             "chain (got " + type(t).__name__ + ")")
+        return t
+
+    def _interpret(self, t) -> Any:
+        """Run a dsl traversal on the interpreter with the same
+        per-request transaction semantics as ``evaluate``."""
+        try:
+            out = t.to_list()
+            self.graph.commit()
+            return out
+        except BaseException:
+            self.graph.rollback()
+            raise
+
+    def traverse(self, body: dict) -> dict:
+        """``POST /traverse`` core (unit-testable without HTTP):
+        compile → fuse → device run; chains outside the compilable
+        subset (or runtime FallbackToInterpreter) answer via the dsl
+        interpreter with ``"fallback": true`` — loud, never silent."""
+        from titan_tpu.olap.serving.interactive import (
+            FallbackToInterpreter, TraversalPlan, compile_traversal,
+            plan_from_wire, traversal_from_plan)
+        tenant = body.get("tenant")
+        timeout_s = float(body.get("timeout_s", 30.0))
+        lane = self.scheduler().interactive()
+        fallback_t = None
+        why = None
+        accounted = False      # did lane.submit already admit/account?
+        if "gremlin" in body:
+            fallback_t = self._script_traversal(body["gremlin"])
+            plan = compile_traversal(fallback_t, lane.max_depth)
+            if plan is None:
+                why = "chain outside the compilable subset"
+        else:
+            plan = plan_from_wire(body)
+        if plan is not None:
+            try:
+                res = lane.submit(plan, tenant=tenant,
+                                  timeout_s=timeout_s)
+                res["result"] = jsonify(res["result"])
+                res["fallback"] = False
+                return res
+            except FallbackToInterpreter as e:
+                why = str(e)
+                accounted = True     # submit admitted + finished it
+                if fallback_t is None and isinstance(plan,
+                                                     TraversalPlan):
+                    fallback_t = traversal_from_plan(
+                        plan, self.graph.traversal())
+        if fallback_t is None:
+            # a ppr plan has no interpreter twin: surface the reason
+            raise ValueError(f"cannot serve request: {why}")
+        # the interpreter ride flows through the SAME tenant quota gate
+        # as compiled traffic (an enforced over-quota tenant gets 429
+        # for uncompilable chains too, QuotaExceeded propagating);
+        # runtime fallbacks were already admitted by lane.submit
+        done = None if accounted else lane.account_fallback(tenant)
+        try:
+            out = self._interpret(fallback_t)
+        except BaseException:
+            if done is not None:
+                done("failed")
+            raise
+        if done is not None:
+            done("fallback")
+        if isinstance(plan, TraversalPlan) and plan.terminal == "count":
+            out = out[0] if out else 0
+        return {"result": jsonify(out), "fallback": True, "why": why}
+
     # -- script evaluation ---------------------------------------------------
 
     def evaluate(self, script: str) -> Any:
@@ -500,12 +611,37 @@ class GraphServer:
                 if not self._authorized():
                     return
                 if self.path not in ("/traversal", "/jobs",
-                                     "/debug/dump"):
+                                     "/traverse", "/debug/dump"):
                     self._send(404, {"error": f"unknown path {self.path}",
                                      "type": "NotFound",
                                      "retryable": False})
                     return
                 length = int(self.headers.get("Content-Length", 0))
+                if self.path == "/traverse":
+                    from titan_tpu.olap.serving.tenants import \
+                        QuotaExceeded
+                    try:
+                        body = json.loads(
+                            self.rfile.read(length) or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError(
+                                "body must be a JSON object")
+                        res = server.traverse(body)
+                    except QuotaExceeded as e:
+                        # before its ValueError parent: 429 + retryable
+                        self._send(*wire_error(e))
+                        return
+                    except (json.JSONDecodeError, ValueError,
+                            TypeError, SyntaxError, NameError) as e:
+                        self._send(400, {"error": str(e),
+                                         "type": type(e).__name__,
+                                         "retryable": False})
+                        return
+                    except BaseException as e:
+                        self._send(*wire_error(e))
+                        return
+                    self._send(200, res)
+                    return
                 if self.path == "/debug/dump":
                     # on-demand postmortem: dump the flight ring + full
                     # system state now, optionally anchored to a job
