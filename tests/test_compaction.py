@@ -196,7 +196,11 @@ def test_round_loop_modules_are_nonzero_free():
     compaction there must go through ops.compaction; and (ISSUE 11) to
     olap/serving/interactive/, whose hops-mode point queries run the
     same per-level plan/sweep kernels (host-side set extraction uses
-    np.flatnonzero, which is not an n-wide device op-scan)."""
+    np.flatnonzero, which is not an n-wide device op-scan); and (ISSUE
+    13) to titan_tpu/parallel/ — the rebuilt sharding layer's exchange
+    primitive and the fused shx_td/shx_bu level kernels compact
+    through ops.compaction too, and the rewritten bfs_hybrid_sharded
+    stays pinned."""
     import importlib
     import inspect
     import io
@@ -207,6 +211,7 @@ def test_round_loop_modules_are_nonzero_free():
     import titan_tpu.olap.live as live_pkg
     import titan_tpu.olap.recovery as recovery_pkg
     import titan_tpu.olap.serving as serving_pkg
+    import titan_tpu.parallel as parallel_pkg
     from titan_tpu.models import bfs_hybrid, bfs_hybrid_sharded, frontier
     from titan_tpu.ops import epoch_merge
 
@@ -238,10 +243,15 @@ def test_round_loop_modules_are_nonzero_free():
         for m in pkgutil.iter_modules(obs_pkg.__path__)]
     # tracing/promexport + slo (ISSUE 8) + devprof/flightrec (ISSUE 10)
     assert len(obs_mods) >= 5
+    parallel_mods = [
+        importlib.import_module(f"titan_tpu.parallel.{m.name}")
+        for m in pkgutil.iter_modules(parallel_pkg.__path__)]
+    # mesh/partition/multihost (ISSUE 13: the sharding layer)
+    assert len(parallel_mods) >= 3
 
     for mod in (frontier, bfs_hybrid, bfs_hybrid_sharded, epoch_merge,
                 *serving_mods, *interactive_mods, *recovery_mods,
-                *live_mods, *obs_mods):
+                *live_mods, *obs_mods, *parallel_mods):
         src = inspect.getsource(mod)
         calls = [
             (tok.start[0], line)

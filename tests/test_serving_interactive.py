@@ -93,9 +93,18 @@ def test_compile_subset_gating(social):
     assert compile_traversal(T().V(1).out().id_()) is None  # no dedup
     assert compile_traversal(T().V(1).out().in_().dedup().id_()) \
         is None                                             # mixed dir
+    # per-hop label changes COMPILE since ISSUE 13 (union lease +
+    # per-level slot masks); the fuse key carries the hop chain
+    mixed = compile_traversal(
+        T().V(1).out("knows").out("likes").dedup().id_())
+    assert mixed is not None \
+        and mixed.hop_labels == (("knows",), ("likes",)) \
+        and mixed.labels == ("knows", "likes") \
+        and mixed.hop_labels in mixed.fuse_key()
+    # ...but an ALL-labels hop inside a labeled chain still interprets
+    # (no union lease carries the unfiltered edge set)
     assert compile_traversal(
-        T().V(1).out("knows").out("likes").dedup().id_()) \
-        is None                                  # per-hop label change
+        T().V(1).out("knows").out().dedup().id_()) is None
     assert compile_traversal(T().V().out().dedup().id_()) is None  # no ids
     assert compile_traversal(T().V(1).dedup().id_()) is None  # no hops
     assert compile_traversal(
@@ -160,6 +169,52 @@ def test_compiled_bit_equal_to_interpreter(social, lane_sched, dirname,
             _check(social, lane, plan_from_wire(
                 {"start": [vid], "dir": dirname, "hops": hops,
                  "terminal": terminal}))
+
+
+def test_mixed_label_chains_bit_equal_to_interpreter(social, lane_sched):
+    """ISSUE 13 satellite: per-hop label changes run COMPILED (union
+    lease + per-level slot masks through frontier_bfs_batched's
+    level_masks seam) and stay bit-equal to the interpreter across
+    directions, depths and the dsl path."""
+    sched, lane = lane_sched
+    ids = _ids(social)
+    f0 = sched._metrics.counter_value("serving.interactive.fallbacks")
+    _check(social, lane, plan_from_wire(
+        {"start": [ids[1]], "dir": "out", "hops": 2,
+         "labels": [["knows"], ["likes"]], "terminal": "id"}))
+    _check(social, lane, plan_from_wire(
+        {"start": [ids[2]], "dir": "both", "hops": 2,
+         "labels": [["likes"], ["knows"]], "terminal": "count"}))
+    _check(social, lane, plan_from_wire(
+        {"start": [ids[5]], "dir": "in", "hops": 3,
+         "labels": [["likes"], ["knows"], ["likes"]],
+         "terminal": "id"}))
+    _check(social, lane, plan_from_wire(
+        {"start": ids[:3], "dir": "out", "hops": 3,
+         "labels": [["knows"], ["knows"], ["likes"]],
+         "terminal": "id"}))
+    # the dsl compile path produces the same plan shape
+    plan = compile_traversal(
+        social.traversal().V(ids[1]).out("knows").out("likes")
+        .dedup().id_())
+    social.rollback()
+    assert plan is not None and plan.hop_labels is not None
+    _check(social, lane, plan)
+    # none of those fell back to the interpreter
+    assert sched._metrics.counter_value(
+        "serving.interactive.fallbacks") == f0
+    # wire validation: per-hop list length must match hops; empty or
+    # non-string sets are 400s
+    with pytest.raises(ValueError):
+        plan_from_wire({"start": [ids[0]], "hops": 3,
+                        "labels": [["a"], ["b"]]})
+    with pytest.raises(ValueError):
+        plan_from_wire({"start": [ids[0]], "hops": 2,
+                        "labels": [["a"], []]})
+    # uniform per-hop form folds back to a plain labeled plan
+    p = plan_from_wire({"start": [ids[0]], "hops": 2,
+                        "labels": [["knows"], ["knows"]]})
+    assert p.hop_labels is None and p.labels == ("knows",)
 
 
 def test_compiled_labels_values_and_multistart(social, lane_sched):

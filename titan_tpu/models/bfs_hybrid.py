@@ -85,6 +85,23 @@ END_C_CAP = 1 << 21
 END_P_CAP = 1 << 22
 
 
+def layout_slot_positions(indptr, deg, n: int):
+    """Edge → slot index (``col*8 + lane``) in the 8-aligned transposed
+    chunk layout, in payload order: vertex v's edge k lands at
+    ``colstart[v]*8 + k``. The ONE definition of the slot arithmetic —
+    ``chunked_layout`` scatters payloads through it and the interactive
+    lane's per-hop label masks (compile.hop_label_masks) index the same
+    slots, so the mask packing can never skew from the device layout.
+    Returns ``(pos int64 [E], colstart int64 [n+1], degc int64 [n])``."""
+    degc = -(-deg // 8)
+    colstart = np.zeros(n + 1, np.int64)
+    np.cumsum(degc, out=colstart[1:])
+    total = int(indptr[n])
+    pos = np.repeat(colstart[:n] * 8 - indptr[:n], deg[:n]) \
+        + np.arange(total, dtype=np.int64)
+    return pos, colstart, degc
+
+
 def chunked_layout(payload, indptr, deg, n: int):
     """The 8-aligned transposed chunk layout shared by the forward
     chunked CSR below and the interactive lane's REVERSED orientation
@@ -92,9 +109,7 @@ def chunked_layout(payload, indptr, deg, n: int):
     definition of the pad convention and the int32 column guard.
     Returns ``(dstT [8, Q] int32 host, colstart int64 [n+1], degc
     int64 [n], q_total)``."""
-    degc = -(-deg // 8)
-    colstart = np.zeros(n + 1, np.int64)
-    np.cumsum(degc, out=colstart[1:])
+    pos, colstart, degc = layout_slot_positions(indptr, deg, n)
     q_total = int(colstart[-1]) + 1          # +1 all-pad column for the sink
     if q_total >= (1 << 31):
         raise NotImplementedError(
@@ -104,11 +119,6 @@ def chunked_layout(payload, indptr, deg, n: int):
     # written and stays INF (writing the in-range sink n instead would
     # leak level values into later bottom-up hit tests)
     flat = np.full(q_total * 8, n + 1, np.int32)
-    # positions of each edge in the 8-aligned layout: vertex v's edge k
-    # lands at colstart[v]*8 + k
-    starts8 = colstart[:n] * 8
-    pos = np.repeat(starts8 - indptr[:n], deg[:n]) \
-        + np.arange(len(payload), dtype=np.int64)
     flat[pos] = payload
     dstT = np.ascontiguousarray(flat.reshape(q_total, 8).T)
     return dstT, colstart, degc, q_total
@@ -948,7 +958,7 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
                          on_level=None, return_device: bool = False,
                          init_dist=None, start_level: int = 0,
                          checkpoint=None, overlay=None,
-                         mode: str = "bfs"):
+                         mode: str = "bfs", level_masks=None):
     """Batched multi-source BFS: run K BFS jobs over the SAME graph as
     one device run with [K, n] state. Each job's ``dist`` row is
     bit-equal to ``frontier_bfs_hybrid`` from that source (BFS distances
@@ -991,6 +1001,25 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
     ``start_level`` in ``init_dist`` (or via ``sources`` when
     ``init_dist`` is None — multi-source rows seed through init_dist).
 
+    Per-level label masks (``level_masks`` — the interactive lane's
+    mixed-label-chain seam, ISSUE 13): a list of per-level edge-slot
+    bitmaps (device uint8, same packing as the overlay tombstone
+    bitmap: byte = chunk column, bit = lane; 1 = the slot does NOT
+    count as a parent this level), indexed ``level - start_level``
+    (None entries and levels past the list run unmasked). This is what
+    lets a ``V(x).out("a").out("b")`` chain compile onto the hops
+    kernels instead of falling back to the interpreter: the lease is
+    the union-label snapshot and each hop masks down to its own label
+    set. Unsupported together with a live overlay (the overlay's
+    add-COO edges carry labels the slot mask cannot filter) — raises
+    ValueError rather than answering wrong.
+
+    Mesh placement (``parallel/partition.place_batched_csr``): a graph
+    dict carrying ``_state_sharding`` pins the ``[K, n+1]`` dist to
+    that ``NamedSharding`` (vertex axis sharded over ``"v"``, K
+    replicated); the kernels are unchanged — GSPMD partitions them
+    from the committed input placements.
+
     Returns ``(dist, levels, completed)``: dist [K, n] (device array
     when ``return_device``, else numpy; INF = unreachable — partial for
     non-completed jobs), levels np int32 [K] (the level at which each
@@ -1005,6 +1034,11 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
         ov = getattr(snap_or_graph, "_live_overlay", None)
     if ov is not None and ov.empty:
         ov = None
+    if level_masks is not None and ov is not None:
+        raise ValueError(
+            "level_masks under a live overlay is unsupported (overlay "
+            "add-edges carry labels the slot mask cannot filter) — "
+            "compact the overlay first or fall back to the interpreter")
     masked = ov is not None and ov.tomb_count > 0
     n = g["n"]
     dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
@@ -1058,6 +1092,12 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
         # fresh run, so a resumed row re-appends it
         dist = jnp.concatenate(
             [jnp.asarray(d), jnp.full((K, 1), INF, jnp.int32)], axis=1)
+    if "_state_sharding" in g:
+        # mesh-placed cohort (parallel/partition.place_batched_csr):
+        # pin the [K, n+1] state to its P(None, "v") placement up front
+        # so the first level doesn't pay a layout decision + reshard
+        import jax
+        dist = jax.device_put(dist, g["_state_sharding"])
     act_h = np.ones(K, bool)
     active = jnp.asarray(act_h)
     levels = np.zeros(K, np.int32)
@@ -1112,6 +1152,18 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
                          dev_scalar(level), cap=ov.cap, n_=n,
                          expand=expand)
         c_count = int(st[0])
+        # per-level label mask (mixed-label hops chains): this level's
+        # slot bitmap rides the SAME tbits seam as overlay tombstones —
+        # one static `masked` variant serves both, so no new kernel
+        # bodies compile (overlay and level_masks are mutually
+        # exclusive, guarded above)
+        tb_l, masked_l = tbits, masked
+        if level_masks is not None:
+            i_lm = level - start_level
+            lm = level_masks[i_lm] \
+                if 0 <= i_lm < len(level_masks) else None
+            if lm is not None:
+                tb_l, masked_l = lm, True
         # chunk rounds over the shared candidate list (bu_more shape)
         off = None
         rounds = 0
@@ -1125,8 +1177,8 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
             fuse = BU_CHUNK_ROUNDS - rounds
             dist, cand, off, prog = bstep(
                 dist, fbits, cand[:c_cap2], off[:c_cap2], prog,
-                dev_scalar(level), dstT, colstart, degc, tbits,
-                c_cap=c_cap2, n_=n, fuse=fuse, masked=masked,
+                dev_scalar(level), dstT, colstart, degc, tb_l,
+                c_cap=c_cap2, n_=n, fuse=fuse, masked=masked_l,
                 expand=expand)
             cand, off = pad(cand), pad(off)
             c_count, rem8 = (int(x) for x in np.asarray(prog))
@@ -1135,8 +1187,8 @@ def frontier_bfs_batched(snap_or_graph, sources, max_levels: int = 1000,
             c_cap2 = min(_next_pow2(max(c_count, 2)), cap_n)
             rem_cap = _next_pow2(max(rem8, 2))
             dist = bex(dist, fbits, cand[:c_cap2], off[:c_cap2], prog,
-                       dev_scalar(level), dstT, colstart, degc, tbits,
-                       c_cap=c_cap2, p_cap=rem_cap, n_=n, masked=masked,
+                       dev_scalar(level), dstT, colstart, degc, tb_l,
+                       c_cap=c_cap2, p_cap=rem_cap, n_=n, masked=masked_l,
                        expand=expand)
         level += 1
     # jobs still active at max_levels count as completed-at-cap

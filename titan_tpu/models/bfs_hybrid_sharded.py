@@ -2,54 +2,81 @@
 
 Round 1's sharded BFS replicated the distance array and pmin-reduced all
 n elements per level (a 256MB all-reduce x levels at scale 26 — VERDICT
-weak point 5). This redesign keeps the EDGE data sharded (the arrays
-that actually dominate memory: each chip holds only its vertex block's
-8-aligned chunked out-CSR) and exchanges only SPARSE newly-found vertex
-lists over ICI:
+weak point 5). The r4 redesign kept the EDGE data sharded (each chip
+holds only its vertex block's 8-aligned chunked out-CSR) and exchanged
+only SPARSE newly-found vertex lists over ICI, but drove every level
+through a CHAIN of host-sized dispatches — td: frontier_of + expand +
+exchange; bu: bu0 + bu_more + bu_exhaust (+ jitted cap trims) +
+exchange — measuring ~2.0× over the plain hybrid on a ONE-device mesh
+(PERF_NOTES r4-late: 4.69s sharded vs 2.32s plain at scale 23), i.e.
+the overhead was dispatch/merge machinery, not communication.
 
-* Top-down level: every chip expands its block's share of the frontier
-  into its local dist replica, counts its discoveries, then one
-  all-gather of [D, found_cap] vertex ids (found_cap = actual per-chip
-  maximum, host-sized) merges them — communication is O(frontier), not
-  O(n). The dist array itself is replicated (n int32 = 268MB at scale
-  26: cheap memory, zero steady-state traffic), a deliberate trade
-  documented here: per-vertex *model state* in the dense engine is
-  sharded; BFS replicates dist precisely so the exchange can be sparse.
-* Bottom-up level: candidates live in their owner's block and check
-  their own in-edges (symmetric graph: the block's out-CSR), so rounds
-  are FULLY LOCAL — parents' dist==level values were settled by the
-  previous level's exchange. Only the level-end found lists are
-  gathered.
+The ISSUE-13 rebuild fuses each level into ONE dispatch per mode per
+cap bucket, the same way ``bfs_hybrid_fused`` fused the single-chip
+head loop:
 
-The host drives levels AND the bottom-up sub-steps exactly like the
-single-chip hybrid: bu0 (candidate build + chunk-0 check) / bu_more
-(fused chunk rounds over the compacted survivors) / bu_exhaust (masked
-sweep of the stragglers), each dispatched at a power-of-two cap bucket
-sized from the PER-CHIP maxima. The round-4 bench measured why this
-matters: the previous single fused bottom-up kernel ran every chunk
-round at full block width (c_cap = pow2(b_max)) and the exhaust at the
-full shard span (p_cap = pow2(q_max)), and a kernel pays its full cap
-in dead lanes — 121s vs 2.3s for the plain hybrid at scale 23 on one
-device (PERF_NOTES.md round 4). The same host-driven path serves
-single- AND multi-process (DCN) meshes (the reference contract: the
-distributed executor runs the SAME machinery as in-process —
-titan-hadoop HadoopScanMapper.java:33-110): the kernels return a
-REPLICATED pmax'd progress vector (so the host never indexes
-per-shard rows of a non-addressable global array), and cap trims of
-the sharded survivor lists run as jitted slices instead of eager
-numpy indexing.
+* **td level** (``shx_td``): frontier list build (replicated
+  compaction of ``dist == level`` — the per-level n-scale pass every
+  design pays once), per-shard expansion of OWNED frontier vertices
+  through the block's local CSR, then the sparse exchange
+  (``parallel/partition.exchange_found``: compact per-shard newly-found
+  ids, all-gather ONLY those lists — O(frontier) comm), the replicated
+  merge and the full stats vector. One dispatch, one host readback.
+* **bu level** (``shx_bu``): per-shard candidate build from the block
+  window + chunk-0 bitmap test, then the fused chunk rounds and the
+  K-chunk-stride exhaust while_loop run INSIDE the same dispatch under
+  a ``lax.cond`` survivor-width ladder (the pmax'd survivor count is
+  replicated, so every shard takes the same branch and collectives
+  stay outside the conds — dead-lane width still tracks the actual
+  per-chip survivor maxima, r4's cap-bucket economics without the
+  host round trips), then the same fused exchange tail. One dispatch.
+
+The per-level all-gather is issued inside the dispatch right after the
+sweep's scatters and BEFORE the n-scale merge/stat reductions, so XLA's
+latency-hiding scheduler can overlap the collective with compute — the
+host-driven chain serialized it behind a dispatch boundary and a stats
+sync. ``found_cap`` is DEVICE-CHECKED exactly as before: the stats
+carry the true per-chip found max and the host retries the LEVEL with
+the exact cap on overflow (the merged result is discarded; the guess
+tracks 4× the previous level's max, so retries are rare) — worst case
+2 dispatches for that level, which is the documented budget:
+``device.exec.calls`` per level ≤ 2 (tests/test_sharded_exchange.py
+pins it through the DeviceCostProfiler).
+
+Explicit shardings end to end (ISSUE 13): the per-shard edge arrays
+upload ONCE through ``parallel/partition.place_shards`` (committed
+``NamedSharding(mesh, P("v", ...))`` — no per-dispatch resharding), the
+replicated vertex arrays through ``place_replicated``, and the kernels
+compile through ``parallel/mesh.mesh_jit`` with OUTPUT shardings pinned
+(dist and stats replicated), cached per (kernel, mesh) and shimmed by
+the device-cost profiler like every single-chip kernel.
+
+The dist array itself stays replicated (n int32 = 268MB at scale 26:
+cheap memory, zero steady-state traffic) — a deliberate trade
+documented here: per-vertex *model state* in the dense engine is
+sharded; BFS replicates dist precisely so the exchange can be sparse.
+Bottom-up levels are FULLY LOCAL until the level-end exchange
+(symmetric graph: candidates check their own block's out-CSR; parents'
+dist==level values were settled by the previous level's exchange).
+
+Single- AND multi-process (DCN) meshes run the SAME driver (the
+reference contract: the distributed executor runs the same machinery as
+in-process — titan-hadoop HadoopScanMapper.java:33-110): the kernels
+return REPLICATED outputs only, so the host never indexes per-shard
+rows of a non-addressable global array; the multihost loader
+(parallel/multihost) supplies host-sharded ``_dev`` arrays through the
+same 6-tuple contract.
 
 Per-shard edge arrays use LOCAL column indices, so each shard stays
 int32-safe as long as its own chunk count is < 2^31 — 8 shards of a
 scale-26 graph are ~35M columns each.
 
-Symmetric graphs only (see bfs_hybrid). Validated against the
-single-chip hybrid on an 8-device CPU mesh in tests/test_sharded_bfs.py.
+Symmetric graphs only (see bfs_hybrid). Validated bit-equal against the
+single-chip hybrid on 1/2/8-device CPU meshes in
+tests/test_sharded_bfs.py and tests/test_sharded_exchange.py.
 """
 
 from __future__ import annotations
-
-import functools
 
 import numpy as np
 
@@ -57,17 +84,9 @@ from titan_tpu.models.bfs import INF, _next_pow2
 from titan_tpu.models.bfs_hybrid import (_bit_of, _pack_bits,
                                          enumerate_chunk_pairs)
 from titan_tpu.ops.compaction import compact_ids, scatter_compact
-from titan_tpu.utils.jitcache import jit_once
 
 ALPHA = 8.0
 BU_CHUNK_ROUNDS = 8
-
-
-def _shard_map(f, **kw):
-    # version-spanning shard_map (deferred import keeps module import
-    # jax-free, matching the rest of this file)
-    from titan_tpu.parallel.mesh import shard_map_compat
-    return shard_map_compat(f, **kw)
 
 # stats vector layout (the exchange's replicated output; the first four
 # entries predate the per-chip cap stats)
@@ -78,7 +97,7 @@ ST_NF, ST_M8F, ST_M8UNVIS, ST_FOUNDMAX, ST_M8F_CHIP, ST_NUNV_CHIP = range(6)
 LAST_EXCHANGE_CAPS: list = []
 # full per-level communication profile of the most recent run: mode,
 # frontier size, per-chip found max, exchange cap/volume, retries, and
-# (bottom-up) the host-driven sub-dispatch cap trail
+# the per-level dispatch count (the fused-kernel budget evidence)
 # (MULTICHIP evidence — the dryrun prints it)
 LAST_PROFILE: list = []
 
@@ -148,10 +167,12 @@ def shard_chunked_csr(snap_or_graph, num_shards: int):
     """Edge-balanced vertex-range shards of the chunked CSR, padded to
     uniform shapes: dict with ``dstT_sh`` [D, 8, Qmax] (pad n+1),
     ``colstart_sh`` [D, Bmax+1] LOCAL column starts, ``degc_sh``
-    [D, Bmax], ``bounds`` [D+1], ``degc`` (global, replicated) — numpy;
-    device placement happens in the runner (shard_map partitions them).
-    Cached on the source object."""
+    [D, Bmax], ``bounds`` [D+1], ``degc`` (global, replicated),
+    ``layout`` (parallel/partition.BlockLayout descriptor) — numpy;
+    device placement happens in the runner (explicit NamedShardings,
+    parallel/partition.place_shards). Cached on the source object."""
     from titan_tpu.models.bfs_hybrid import build_chunked_csr
+    from titan_tpu.parallel.partition import block_layout
 
     if isinstance(snap_or_graph, dict):
         g = snap_or_graph
@@ -180,201 +201,148 @@ def shard_chunked_csr(snap_or_graph, num_shards: int):
                 "a to_device() result")
     colstart = np.asarray(colstart)
     dstT = np.asarray(dstT)
-    bounds, b_max, q_max = plan_shard_cuts(colstart, n, num_shards)
-    d_eff = len(bounds) - 1
+    layout = block_layout(colstart, degc_all, n, num_shards)
+    bounds_full = np.asarray(layout.bounds, np.int64)
+    b_max, q_max = layout.b_max, layout.q_max
+    d_eff = layout.live_shards
     total = int(colstart[n])
     dstT_sh = np.full((num_shards, 8, q_max), n + 1, np.int32)
     colstart_sh = np.zeros((num_shards, b_max + 1), np.int32)
     degc_sh = np.zeros((num_shards, b_max), np.int32)
     for d in range(d_eff):
         dstT_sh[d], colstart_sh[d], degc_sh[d] = pack_shard_block(
-            d, colstart, dstT, degc_all, bounds, b_max, q_max, n)
-    bounds_full = np.zeros(num_shards + 1, np.int64)
-    bounds_full[:len(bounds)] = bounds
-    bounds_full[len(bounds):] = n
+            d, colstart, dstT, degc_all, bounds_full, b_max, q_max, n)
     out = {
         "dstT_sh": dstT_sh, "colstart_sh": colstart_sh,
         "degc_sh": degc_sh, "bounds": bounds_full, "n": n,
         "b_max": b_max, "q_max": q_max, "q_total": q_total,
         "degc": np.concatenate([degc_all, [0]]).astype(np.int32),
         "total_chunks": total,
+        "layout": layout,
         # per-shard chunk spans — the edge-balance evidence the comm
         # profile reports (cuts are planned on the chunk prefix, so
         # these should be near-uniform)
-        "shard_chunks": [int(colstart[bounds[d + 1]] - colstart[bounds[d]])
-                         for d in range(d_eff)],
-        "nunv_chip_max": shard_unvisited_cap(degc_all, bounds),
+        "shard_chunks": list(layout.shard_chunks),
+        "nunv_chip_max": layout.nunv_cap,
     }
     if isinstance(g, dict):
         g["_shards"] = (num_shards, out)
     return out
 
 
-def _td_expand():
-    def build():
-        import jax
+# ---------------------------------------------------------------------------
+# fused per-level kernels (one dispatch per level per cap bucket)
+# ---------------------------------------------------------------------------
+
+def _exchange_tail(dist, level, degc, degc_l, lo, hi, found_cap: int,
+                   n_: int, b_max: int):
+    """The fused exchange, traced inline at the end of BOTH level
+    kernels: sparse found-list gather (parallel/partition.
+    exchange_found — O(frontier) comm, issued before the n-scale
+    merge/stat reductions so the collective can overlap them), the
+    replicated merge, and the stats vector whose per-chip maxima size
+    the NEXT level's kernel caps (frontier chunk mass owned by one
+    chip; unvisited expandable vertices in one block) so dead-lane
+    width never exceeds one chip's actual share. ``found_cap`` is
+    device-checked via ST_FOUNDMAX (host retries the level on
+    overflow)."""
+    import jax
+    import jax.numpy as jnp
+
+    from titan_tpu.parallel.mesh import VERTEX_AXIS
+    from titan_tpu.parallel.partition import exchange_found
+
+    newly = dist[:n_] == level + 1
+    all_ids, found_max = exchange_found(newly, found_cap, n_)
+    merged = dist.at[all_ids.ravel()].min(level + 1, mode="drop")
+    changed = merged[:n_] == level + 1
+    nf = changed.sum().astype(jnp.int32)
+    m8_f = jnp.where(changed, degc[:n_], 0).sum(dtype=jnp.int32)
+    unvis = merged[:n_] >= INF
+    m8_unvis = jnp.where(unvis, degc[:n_], 0).sum(dtype=jnp.int32)
+    # per-chip cap stats over this chip's block window
+    blk = jnp.minimum(lo + jnp.arange(b_max, dtype=jnp.int32), n_)
+    bmask = jnp.arange(b_max, dtype=jnp.int32) < (hi - lo)
+    vis_blk = merged[blk]
+    m8f_chip = jnp.where(bmask & (vis_blk == level + 1), degc_l, 0) \
+        .sum(dtype=jnp.int32)
+    nunv_chip = (bmask & (vis_blk >= INF) & (degc_l > 0)) \
+        .sum().astype(jnp.int32)
+    m8f_chip = jax.lax.pmax(m8f_chip, VERTEX_AXIS)
+    nunv_chip = jax.lax.pmax(nunv_chip, VERTEX_AXIS)
+    return merged, jnp.stack(
+        [nf, m8_f, m8_unvis, found_max, m8f_chip, nunv_chip])
+
+
+def _td_level(mesh):
+    """One whole top-down level, fused: frontier build + owned-share
+    expansion + sparse exchange + stats. Compiled once per (mesh,
+    f_cap, p_cap, found_cap) via mesh_jit with replicated out
+    shardings pinned."""
+    from jax.sharding import PartitionSpec as P
+
+    from titan_tpu.parallel.mesh import VERTEX_AXIS, mesh_jit
+
+    def builder(mesh):
         import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
 
-        from titan_tpu.parallel.mesh import VERTEX_AXIS
+        from titan_tpu.parallel.mesh import shard_map_compat
 
-        @functools.partial(
-            jax.jit,
-            static_argnames=("mesh", "f_cap", "p_cap", "n_", "b_max"))
-        def td(dist, frontier, stats, level, dstT_sh, colstart_sh,
-               degc_sh, lo_sh, hi_sh, mesh, f_cap: int, p_cap: int,
+        def td(dist, stats, level, dstT_sh, colstart_sh, degc_sh, degc,
+               lo_sh, hi_sh, f_cap: int, p_cap: int, found_cap: int,
                n_: int, b_max: int):
-            """Local expansion: returns the per-chip updated dist.
-            The frontier count arrives as the previous exchange's DEVICE
-            stats vector (stats[0]) — a per-level scalar put would cost
-            a tunnel round trip."""
-            f_count = stats[0]
-            def per_shard(dist, frontier, dstT_l, cs_l, degc_l, lo, hi):
+            def per_shard(dist, degc, dstT_l, cs_l, degc_l, lo, hi):
                 dstT_l, cs_l, degc_l = dstT_l[0], cs_l[0], degc_l[0]
                 lo, hi = lo[0], hi[0]
+                q_pad = dstT_l.shape[1] - 1
+                f_count = stats[ST_NF]
+                # frontier list from the merged dist (replicated
+                # compaction — deduped by construction, so chunk-pair
+                # enumeration never double-counts a vertex's mass)
+                _, frontier = compact_ids(dist[:n_] == level, f_cap,
+                                          n_ + 1)
                 valid = (jnp.arange(f_cap) < f_count) \
                     & (frontier >= lo) & (frontier < hi)
                 v = jnp.clip(frontier - lo, 0, b_max - 1)
                 cols, _, _ = enumerate_chunk_pairs(
-                    valid, degc_l[v], cs_l[v], p_cap,
-                    dstT_l.shape[1] - 1)
+                    valid, degc_l[v], cs_l[v], p_cap, q_pad)
                 nbr = jnp.take(dstT_l, cols, axis=1)
-                return dist.at[nbr].min(level + 1, mode="drop")[None]
+                dist = dist.at[nbr].min(level + 1, mode="drop")
+                return _exchange_tail(dist, level, degc, degc_l, lo,
+                                      hi, found_cap, n_, b_max)
 
-            return _shard_map(
+            return shard_map_compat(
                 per_shard, mesh=mesh,
                 in_specs=(P(), P(), P(VERTEX_AXIS, None, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS), P(VERTEX_AXIS)),
-                out_specs=P(VERTEX_AXIS, None),
-            )(dist, frontier, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
-        return td
-    return jit_once("shbfs_td", build)
-
-
-def _exchange():
-    def build():
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
-
-        from titan_tpu.parallel.mesh import VERTEX_AXIS
-
-        @functools.partial(
-            jax.jit, static_argnames=("mesh", "found_cap", "n_", "b_max"))
-        def ex(dist_sh, level, degc, degc_sh, lo_sh, hi_sh, mesh,
-               found_cap: int, n_: int, b_max: int):
-            """Merge per-chip discoveries: all-gather each chip's newly-
-            found ids and apply to every replica; returns merged dist
-            (replicated) + stats + the new frontier list. ``found_cap``
-            is DEVICE-CHECKED: stats carry the true per-chip found max,
-            and the host retries with a bigger cap on overflow (the
-            merged result is then discarded) — no pre-sizing readback.
-            The stats also carry the PER-CHIP maxima that size the next
-            level's kernel caps (frontier chunk mass owned by one chip;
-            unvisited expandable vertices in one block) so dead-lane
-            width never exceeds one chip's actual share."""
-            def per_shard(dist, degc, degc_l, lo, hi):
-                degc_l = degc_l[0]
-                lo, hi = lo[0], hi[0]
-                newly = dist[0][:n_] == level + 1
-                cnt = newly.sum().astype(jnp.int32)
-                found_max = jax.lax.pmax(cnt, VERTEX_AXIS)
-                # exchange list build via the shared scan/scatter
-                # compaction (ops.compaction) — same n-wide-nonzero
-                # elimination as the single-chip round loops
-                _, ids = compact_ids(newly, found_cap, n_ + 1)
-                all_ids = jax.lax.all_gather(ids, VERTEX_AXIS)  # [D, cap]
-                merged = dist[0].at[all_ids.ravel()].min(
-                    level + 1, mode="drop")
-                changed = merged[:n_] == level + 1
-                nf = changed.sum().astype(jnp.int32)
-                m8_f = jnp.where(changed, degc[:n_], 0) \
-                    .sum(dtype=jnp.int32)
-                unvis = merged[:n_] >= INF
-                m8_unvis = jnp.where(unvis, degc[:n_], 0) \
-                    .sum(dtype=jnp.int32)
-                # per-chip cap stats over this chip's block window
-                blk = jnp.minimum(
-                    lo + jnp.arange(b_max, dtype=jnp.int32), n_)
-                bmask = jnp.arange(b_max, dtype=jnp.int32) < (hi - lo)
-                vis_blk = merged[blk]
-                m8f_chip = jnp.where(
-                    bmask & (vis_blk == level + 1), degc_l, 0) \
-                    .sum(dtype=jnp.int32)
-                nunv_chip = (bmask & (vis_blk >= INF) & (degc_l > 0)) \
-                    .sum().astype(jnp.int32)
-                m8f_chip = jax.lax.pmax(m8f_chip, VERTEX_AXIS)
-                nunv_chip = jax.lax.pmax(nunv_chip, VERTEX_AXIS)
-                return merged, jnp.stack(
-                    [nf, m8_f, m8_unvis, found_max, m8f_chip, nunv_chip])
-
-            return _shard_map(
-                per_shard, mesh=mesh,
-                in_specs=(P(VERTEX_AXIS, None), P(), P(VERTEX_AXIS, None),
-                          P(VERTEX_AXIS), P(VERTEX_AXIS)),
                 out_specs=(P(), P()),
-            )(dist_sh, degc, degc_sh, lo_sh, hi_sh)
-        return ex
-    return jit_once("shbfs_exchange", build)
+            )(dist, degc, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
+        return td
+
+    return mesh_jit(
+        "shx_td", mesh, builder, out_specs=(P(), P()),
+        static_argnames=("f_cap", "p_cap", "found_cap", "n_", "b_max"))
 
 
-def _frontier_of_sh():
-    def build():
+def _bu_level(mesh):
+    """One whole bottom-up level, fused: candidate build + chunk-0
+    bitmap test + fused chunk rounds + K-stride exhaust (inside a
+    replicated survivor-width cond ladder) + sparse exchange + stats.
+    One dispatch per level per (c_cap, found_cap) bucket."""
+    from jax.sharding import PartitionSpec as P
+
+    from titan_tpu.parallel.mesh import VERTEX_AXIS, mesh_jit
+
+    def builder(mesh):
         import jax
         import jax.numpy as jnp
 
-        @functools.partial(jax.jit, static_argnames=("n_",))
-        def fr(dist, level, n_: int):
-            """Frontier list of ``dist == level`` — built lazily ONLY
-            before a top-down level (bottom-up levels never consume a
-            frontier list, and the n-scale nonzero was the exchange's
-            single biggest per-level cost on bu-heavy runs)."""
-            changed = dist[:n_] == level
-            return compact_ids(changed, n_, n_)[1]
-        return fr
-    return jit_once("shbfs_frontier_of", build)
+        from titan_tpu.parallel.mesh import shard_map_compat
 
-
-def _trim_cols():
-    def build():
-        import jax
-
-        @functools.partial(jax.jit, static_argnames=("c2",))
-        def trim(a, c2: int):
-            """Cap-trim a [D, cap] sharded array to [D, c2] ON DEVICE —
-            eager numpy slicing of a non-addressable global array raises
-            in multi-process meshes; a jitted slice along the unsharded
-            axis preserves the shard layout and works on any mesh."""
-            return a[:, :c2]
-        return trim
-    return jit_once("shbfs_trim", build)
-
-
-def _bu_start_sh():
-    def build():
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
-
-        from titan_tpu.parallel.mesh import VERTEX_AXIS
-
-        @functools.partial(
-            jax.jit, static_argnames=("mesh", "c_cap", "n_", "b_max"))
-        def bu0(dist, level, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh,
-                mesh, c_cap: int, n_: int, b_max: int):
-            """Bottom-up level opener (host-driven path): per-shard
-            candidate build from the block window + chunk-0 bitmap test,
-            survivors compacted under lax.cond (skipped at heavy levels
-            where chunk 0 decides everyone — the single-chip hybrid
-            measured the unconditional compaction at ~2.5s). Returns
-            per-chip (dist, fbits, cand, off, prog=[nc, rem8]) plus a
-            REPLICATED pmax'd [nc_max, rem8_max] the host can read on
-            any mesh (multi-process included — per-shard rows of a
-            global array are not host-addressable there).
-            Caller guarantee: per-chip candidate count <= c_cap (sized
-            from the exchange's nunv_chip pmax)."""
-            def per_shard(dist, dstT_l, cs_l, degc_l, lo, hi):
+        def bu(dist, level, dstT_sh, colstart_sh, degc_sh, degc, lo_sh,
+               hi_sh, c_cap: int, found_cap: int, n_: int, b_max: int):
+            def per_shard(dist, degc, dstT_l, cs_l, degc_l, lo, hi):
                 dstT_l, cs_l, degc_l = dstT_l[0], cs_l[0], degc_l[0]
                 lo, hi = lo[0], hi[0]
                 q_pad = dstT_l.shape[1] - 1
@@ -389,172 +357,114 @@ def _bu_start_sh():
                 cols = jnp.where(alive, cs_l[lv], q_pad)
                 parents = jnp.take(dstT_l, jnp.clip(cols, 0, q_pad),
                                    axis=1)
-                hit = _bit_of(fbits, parents)
-                found = alive & hit.any(axis=0)
+                found = alive & _bit_of(fbits, parents).any(axis=0)
                 dist = dist.at[jnp.where(found, lv + lo, n_ + 1)].set(
                     level + 1, mode="drop")
                 surv = alive & ~found & (degc_l[lv] > 1)
                 nc = surv.sum().astype(jnp.int32)
+                # REPLICATED survivor max: every shard takes the same
+                # ladder branch, so no collective ever sits inside a
+                # cond (a divergent branch with a collective deadlocks
+                # the mesh); dead-lane width still tracks the actual
+                # per-chip survivor maximum — the r4 cap-bucket
+                # economics, now without the host round trip
+                nc_max = jax.lax.pmax(nc, VERTEX_AXIS)
 
-                def compact(_):
-                    # survivor list + its chunk cursor through ONE
-                    # shared index (ops.compaction fuses the pair)
-                    _, (cand2, off2) = scatter_compact(
-                        surv, (cand, jnp.ones((c_cap,), jnp.int32)),
-                        c_cap, (b_max, 0))
-                    rem8 = jnp.where(surv, degc_l[lv] - 1, 0) \
-                        .sum(dtype=jnp.int32)
-                    return cand2, off2, rem8
+                def rounds_at(w: int):
+                    def go(dist):
+                        _, (cand_w, off_w) = scatter_compact(
+                            surv, (cand, jnp.ones((c_cap,), jnp.int32)),
+                            w, (b_max, 0))
+                        ncr = jnp.minimum(nc, w)
 
-                def no_compact(_):
-                    return (jnp.full((c_cap,), b_max, jnp.int32),
-                            jnp.zeros((c_cap,), jnp.int32), jnp.int32(0))
+                        def round_(state, _):
+                            dist, cand, off, ncr = state
+                            alv = jnp.arange(w) < ncr
+                            lvv = jnp.clip(cand, 0, b_max - 1)
+                            cls = jnp.where(alv, cs_l[lvv] + off, q_pad)
+                            par = jnp.take(dstT_l,
+                                           jnp.clip(cls, 0, q_pad),
+                                           axis=1)
+                            ft = alv & _bit_of(fbits, par).any(axis=0)
+                            dist = dist.at[
+                                jnp.where(ft, lvv + lo, n_ + 1)].set(
+                                level + 1, mode="drop")
+                            sv = alv & ~ft & (off + 1 < degc_l[lvv])
+                            nc2, (cand, off) = scatter_compact(
+                                sv, (cand, off + 1), w, (b_max, 0))
+                            return (dist, cand, off, nc2), None
 
-                cand2, off2, rem8 = jax.lax.cond(
-                    nc > 0, compact, no_compact, None)
-                prog_max = jnp.stack(
-                    [jax.lax.pmax(nc, VERTEX_AXIS),
-                     jax.lax.pmax(rem8, VERTEX_AXIS)])
-                return (dist[None], fbits[None], cand2[None], off2[None],
-                        jnp.stack([nc, rem8])[None], prog_max)
+                        (dist, cand_w, off_w, ncr), _ = jax.lax.scan(
+                            round_, (dist, cand_w, off_w, ncr), None,
+                            length=BU_CHUNK_ROUNDS - 1)
+                        # stragglers: K-chunk-stride while_loop — every
+                        # iteration checks the next K chunks of EVERY
+                        # survivor, so completion is guaranteed for any
+                        # degree (no p_cap to size, no dropped hub
+                        # chunks, no host sync; per-shard trip counts
+                        # are fine — the loop is collective-free)
+                        K = max((1 << 16) // max(w, 1), 1)
 
-            return _shard_map(
+                        def ex_cond(s):
+                            return s[3] > 0
+
+                        def ex_body(s):
+                            dist, cand, off, ncr = s
+                            alv = jnp.arange(w) < ncr
+                            lvv = jnp.clip(cand, 0, b_max - 1)
+                            rem = jnp.where(
+                                alv,
+                                jnp.maximum(degc_l[lvv] - off, 0), 0)
+                            j = jnp.arange(K, dtype=jnp.int32)[None, :]
+                            cls = (cs_l[lvv] + off)[:, None] + j
+                            live = alv[:, None] & (j < rem[:, None])
+                            cls = jnp.where(live,
+                                            jnp.clip(cls, 0, q_pad),
+                                            q_pad)
+                            par = jnp.take(dstT_l, cls.reshape(-1),
+                                           axis=1)
+                            hit = _bit_of(fbits, par).any(axis=0) \
+                                .reshape(w, K)
+                            ft = alv & (hit & live).any(axis=1)
+                            dist = dist.at[
+                                jnp.where(ft, lvv + lo, n_ + 1)].set(
+                                level + 1, mode="drop")
+                            sv = alv & ~ft & (rem > K)
+                            nc2, (cand, off) = scatter_compact(
+                                sv, (cand, off + K), w, (b_max, 0))
+                            return (dist, cand, off, nc2)
+
+                        dist, _, _, _ = jax.lax.while_loop(
+                            ex_cond, ex_body, (dist, cand_w, off_w, ncr))
+                        return dist
+                    return go
+
+                def pick(dist, ladder):
+                    if len(ladder) == 1:
+                        return rounds_at(ladder[0])(dist)
+                    return jax.lax.cond(nc_max <= ladder[0],
+                                        rounds_at(ladder[0]),
+                                        lambda d: pick(d, ladder[1:]),
+                                        dist)
+
+                wl = sorted({max(c_cap // 8, min(8, c_cap)), c_cap})
+                dist = jax.lax.cond(nc_max == 0, lambda d: d,
+                                    lambda d: pick(d, wl), dist)
+                return _exchange_tail(dist, level, degc, degc_l, lo,
+                                      hi, found_cap, n_, b_max)
+
+            return shard_map_compat(
                 per_shard, mesh=mesh,
-                in_specs=(P(), P(VERTEX_AXIS, None, None),
+                in_specs=(P(), P(), P(VERTEX_AXIS, None, None),
                           P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
                           P(VERTEX_AXIS), P(VERTEX_AXIS)),
-                out_specs=(P(VERTEX_AXIS, None),) * 5 + (P(),),
-            )(dist, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
-        return bu0
-    return jit_once("shbfs_bu0", build)
-
-
-def _bu_more_sh():
-    def build():
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
-
-        from titan_tpu.parallel.mesh import VERTEX_AXIS
-
-        @functools.partial(
-            jax.jit,
-            static_argnames=("mesh", "c_cap", "n_", "b_max", "fuse"),
-            donate_argnums=(0,))
-        def bu(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, level,
-               colstart_sh, degc_sh, lo_sh, dstT_sh, mesh, c_cap: int,
-               n_: int, b_max: int, fuse: int):
-            """``fuse`` chunk-check rounds over the per-chip compacted
-            survivor lists; survivor count arrives in each chip's row of
-            the DEVICE prog vector (no scalar put)."""
-            def per_shard(dist, fbits, cand, off, prog, cs_l, degc_l,
-                          lo, dstT_l):
-                dist, fbits, cand, off, prog = (
-                    dist[0], fbits[0], cand[0], off[0], prog[0])
-                cs_l, degc_l, lo, dstT_l = (
-                    cs_l[0], degc_l[0], lo[0], dstT_l[0])
-                q_pad = dstT_l.shape[1] - 1
-                c_count = prog[0]
-
-                def round_(state, _):
-                    dist, cand, off, c_count = state
-                    alive = jnp.arange(c_cap) < c_count
-                    lv = jnp.clip(cand, 0, b_max - 1)
-                    cols = jnp.where(alive, cs_l[lv] + off, q_pad)
-                    parents = jnp.take(dstT_l, jnp.clip(cols, 0, q_pad),
-                                       axis=1)
-                    hit = _bit_of(fbits, parents)
-                    found = alive & hit.any(axis=0)
-                    dist = dist.at[jnp.where(found, lv + lo, n_ + 1)] \
-                        .set(level + 1, mode="drop")
-                    surv = alive & ~found & (off + 1 < degc_l[lv])
-                    nc, (cand, off) = scatter_compact(
-                        surv, (cand, off + 1), c_cap, (b_max, 0))
-                    return (dist, cand, off, nc), None
-
-                (dist, cand, off, c_count), _ = jax.lax.scan(
-                    round_, (dist, cand, off, c_count), None,
-                    length=fuse)
-                alive = jnp.arange(c_cap) < c_count
-                lv = jnp.clip(cand, 0, b_max - 1)
-                rem = jnp.where(alive,
-                                jnp.maximum(degc_l[lv] - off, 0), 0) \
-                    .sum(dtype=jnp.int32)
-                prog_max = jnp.stack(
-                    [jax.lax.pmax(c_count, VERTEX_AXIS),
-                     jax.lax.pmax(rem, VERTEX_AXIS)])
-                return (dist[None], cand[None], off[None],
-                        jnp.stack([c_count, rem])[None], prog_max)
-
-            return _shard_map(
-                per_shard, mesh=mesh,
-                in_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
-                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
-                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
-                          P(VERTEX_AXIS, None), P(VERTEX_AXIS),
-                          P(VERTEX_AXIS, None, None)),
-                out_specs=(P(VERTEX_AXIS, None),) * 4 + (P(),),
-            )(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, colstart_sh,
-              degc_sh, lo_sh, dstT_sh)
+                out_specs=(P(), P()),
+            )(dist, degc, dstT_sh, colstart_sh, degc_sh, lo_sh, hi_sh)
         return bu
-    return jit_once("shbfs_bu_more", build)
 
-
-def _bu_exhaust_sh():
-    def build():
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
-
-        from titan_tpu.parallel.mesh import VERTEX_AXIS
-
-        @functools.partial(
-            jax.jit,
-            static_argnames=("mesh", "c_cap", "p_cap", "n_", "b_max"),
-            donate_argnums=(0,))
-        def ex(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, level,
-               colstart_sh, degc_sh, lo_sh, dstT_sh, mesh, c_cap: int,
-               p_cap: int, n_: int, b_max: int):
-            """Masked sweep over ALL remaining chunks of each chip's
-            surviving candidates (p_cap sized from the per-chip rem8
-            max, not the shard span)."""
-            def per_shard(dist, fbits, cand, off, prog, cs_l, degc_l,
-                          lo, dstT_l):
-                dist, fbits, cand, off, prog = (
-                    dist[0], fbits[0], cand[0], off[0], prog[0])
-                cs_l, degc_l, lo, dstT_l = (
-                    cs_l[0], degc_l[0], lo[0], dstT_l[0])
-                q_pad = dstT_l.shape[1] - 1
-                c_count = prog[0]
-                valid = jnp.arange(c_cap) < c_count
-                lv = jnp.clip(cand, 0, b_max - 1)
-                rem = jnp.maximum(degc_l[lv] - off, 0)
-                cols, p_total, owner = enumerate_chunk_pairs(
-                    valid, rem, cs_l[lv] + off, p_cap, q_pad,
-                    with_owner=True)
-                parents = jnp.take(dstT_l, cols, axis=1)
-                hit = _bit_of(fbits, parents).any(axis=0)
-                j = jnp.arange(p_cap, dtype=jnp.int32)
-                found_per = jnp.zeros((c_cap,), jnp.int32) \
-                    .at[jnp.where(j < p_total, owner, c_cap - 1)] \
-                    .max(hit.astype(jnp.int32), mode="drop")
-                found = valid & (found_per > 0)
-                dist = dist.at[jnp.where(found, lv + lo, n_ + 1)].set(
-                    level + 1, mode="drop")
-                return dist[None]
-
-            return _shard_map(
-                per_shard, mesh=mesh,
-                in_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
-                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
-                          P(VERTEX_AXIS, None), P(VERTEX_AXIS, None),
-                          P(VERTEX_AXIS, None), P(VERTEX_AXIS),
-                          P(VERTEX_AXIS, None, None)),
-                out_specs=P(VERTEX_AXIS, None),
-            )(dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, colstart_sh,
-              degc_sh, lo_sh, dstT_sh)
-        return ex
-    return jit_once("shbfs_bu_ex", build)
+    return mesh_jit(
+        "shx_bu", mesh, builder, out_specs=(P(), P()),
+        static_argnames=("c_cap", "found_cap", "n_", "b_max"))
 
 
 def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
@@ -578,31 +488,28 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
             "arrays); pad the snapshot to the next power of two")
     dev = sh.get("_dev")
     if dev is None:
-        # upload once and cache — re-uploading ~9GB of edge shards per
-        # call would dominate every timed run
+        # upload once to the EXPLICIT final placement and cache —
+        # re-uploading ~9GB of edge shards per call would dominate every
+        # timed run, and uncommitted arrays would pay a reshard on
+        # every dispatch
+        from titan_tpu.parallel.partition import (place_replicated,
+                                                  place_shards)
         bounds = sh["bounds"]
-        dev = (jnp.asarray(sh["dstT_sh"]), jnp.asarray(sh["colstart_sh"]),
-               jnp.asarray(sh["degc_sh"]), jnp.asarray(sh["degc"]),
-               jnp.asarray(bounds[:-1].astype(np.int32)),
-               jnp.asarray(bounds[1:].astype(np.int32)))
+        dstT_sh, colstart_sh, degc_sh = place_shards(
+            mesh, sh["dstT_sh"], sh["colstart_sh"], sh["degc_sh"])
+        lo_sh, hi_sh = place_shards(
+            mesh, bounds[:-1].astype(np.int32),
+            bounds[1:].astype(np.int32))
+        degc, = place_replicated(mesh, sh["degc"])
+        dev = (dstT_sh, colstart_sh, degc_sh, degc, lo_sh, hi_sh)
         sh["_dev"] = dev
     dstT_sh, colstart_sh, degc_sh, degc, lo_sh, hi_sh = dev
     total_chunks = sh["total_chunks"]
     cap_b = _next_pow2(max(b_max, 2))
     cap_q = _next_pow2(max(sh["q_max"], 2))
-    td = _td_expand()
-    ex = _exchange()
-    fr_of = _frontier_of_sh()
+    td = _td_level(mesh)
+    bu = _bu_level(mesh)
 
-    def pad(a):
-        if a.shape[0] < cap_n:
-            a = jnp.concatenate(
-                [a, jnp.full((cap_n - a.shape[0],), n, a.dtype)])
-        return a
-
-    # dist flow: replicated [n+1] into td/bu (each chip updates its own
-    # copy -> [D, n+1] out), merged back to replicated [n+1] by the
-    # exchange
     from titan_tpu.utils.jitcache import dev_scalar
 
     f_count = 1
@@ -622,14 +529,13 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
         d0 = np.full((n + 1,), INF, np.int32)
         d0[source_dense] = 0
         dist = host_replicated(mesh, d0)
-        fr0 = np.full((cap_n,), n, np.int32)
-        fr0[0] = source_dense
-        frontier = host_replicated(mesh, fr0)
         st_dev = host_replicated(mesh, st0)
     else:
-        dist = jnp.full((n + 1,), INF, jnp.int32).at[source_dense].set(0)
-        frontier = pad(jnp.full((1,), source_dense, jnp.int32))
-        st_dev = jnp.asarray(st0)
+        from titan_tpu.parallel.partition import place_replicated
+        dist, st_dev = place_replicated(
+            mesh,
+            jnp.full((n + 1,), INF, jnp.int32).at[source_dense].set(0),
+            st0)
     level = 0
     # level-0 discoveries are bounded by the source's degree — seed the
     # exchange cap from it instead of always paying an overflow retry
@@ -639,88 +545,60 @@ def frontier_bfs_hybrid_sharded(snap_or_graph, source_dense: int, mesh,
     num_dev = int(mesh.devices.size)
     while f_count > 0 and level < max_levels:
         use_bu = m8_f * ALPHA > m8_unvis and f_count > 1
-        bu_trail: list = []
-        if not use_bu:
-            if m8_f == 0:
-                break
-            if frontier is None:
-                frontier = pad(fr_of(dist, dev_scalar(level), n_=n))
-            f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
-            # p_cap covers the heaviest single chip's OWNED share of the
-            # frontier mass (each vertex expands on its owner only)
-            p_cap = min(_next_pow2(max(m8f_chip, 2)), cap_q)
-            dist_sh = td(dist, frontier[:f_cap], st_dev,
-                         dev_scalar(level), dstT_sh, colstart_sh,
-                         degc_sh, lo_sh, hi_sh, mesh=mesh,
-                         f_cap=f_cap, p_cap=p_cap, n_=n, b_max=b_max)
-        else:
-            # host-driven bottom-up: bu0 / fused bu_more rounds /
-            # exhaust, each at the per-chip cap bucket (see module doc).
-            # Single- AND multi-process: the host only ever reads the
-            # REPLICATED pmax'd progress vector, and cap trims run as
-            # jitted slices (r4's fused full-width DCN fallback — 52x
-            # slower at scale 23 — is deleted).
-            bu0 = _bu_start_sh()
-            bu_more = _bu_more_sh()
-            bu_ex = _bu_exhaust_sh()
-            trim = _trim_cols()
-            c_cap = min(_next_pow2(max(nunv_chip, 2)), cap_b)
-            dist_sh, fbits_sh, cand_sh, off_sh, prog_sh, prog_max = bu0(
-                dist, dev_scalar(level), dstT_sh, colstart_sh, degc_sh,
-                lo_sh, hi_sh, mesh=mesh, c_cap=c_cap, n_=n, b_max=b_max)
-            nc_max, rem8_max = (int(x) for x in np.asarray(prog_max))
-            bu_trail.append({"step": "bu0", "c_cap": c_cap,
-                             "nc_max": nc_max})
-            if nc_max > 0:
-                # one fused dispatch covers the remaining chunk rounds
-                # (bu0 already consumed chunk 0) at the survivor cap
-                c2 = min(_next_pow2(max(nc_max, 2)), c_cap)
-                dist_sh, cand_sh, off_sh, prog_sh, prog_max = bu_more(
-                    dist_sh, fbits_sh, trim(cand_sh, c2=c2),
-                    trim(off_sh, c2=c2), prog_sh, dev_scalar(level),
-                    colstart_sh, degc_sh, lo_sh, dstT_sh, mesh=mesh,
-                    c_cap=c2, n_=n, b_max=b_max,
-                    fuse=BU_CHUNK_ROUNDS - 1)
-                nc_max, rem8_max = (int(x) for x in np.asarray(prog_max))
-                bu_trail.append({"step": "bu_more", "c_cap": c2,
-                                 "fuse": BU_CHUNK_ROUNDS - 1,
-                                 "nc_max": nc_max})
-            if nc_max > 0:
-                c2 = min(_next_pow2(max(nc_max, 2)), c_cap)
-                p2 = min(_next_pow2(max(rem8_max, 2)), cap_q)
-                dist_sh = bu_ex(
-                    dist_sh, fbits_sh, trim(cand_sh, c2=c2),
-                    trim(off_sh, c2=c2), prog_sh, dev_scalar(level),
-                    colstart_sh, degc_sh, lo_sh, dstT_sh, mesh=mesh,
-                    c_cap=c2, p_cap=p2, n_=n, b_max=b_max)
-                bu_trail.append({"step": "bu_exhaust", "c_cap": c2,
-                                 "p_cap": p2})
-        # device-sized exchange: dispatch with the adaptive guess cap and
-        # read ONE stats vector back (the only host sync of a td level);
-        # the stats carry the true per-chip found max, so an overflowed
-        # merge is discarded and re-run with the exact cap (rare — the
-        # guess tracks 4x the previous level's max)
+        if not use_bu and m8_f == 0:
+            break
+        # one fused dispatch per level (mode- and cap-bucketed); the
+        # SOLE host sync per level is the stats readback below. An
+        # exchange-cap overflow re-runs the level with the exact cap
+        # (the merged result is discarded — dist was not donated), so
+        # the per-level dispatch budget is 1 + retries ≤ 2 in steady
+        # state (the guess tracks 4x the previous level's max).
         found_cap, retries = found_guess, 0
+        bu_caps = {}
         while True:
-            dist_m, st = ex(dist_sh, dev_scalar(level), degc,
-                            degc_sh, lo_sh, hi_sh, mesh=mesh,
-                            found_cap=found_cap, n_=n, b_max=b_max)
-            (f_count, m8_f, m8_unvis, found_max, m8f_chip,
-             nunv_chip) = (int(x) for x in np.asarray(st))
+            if use_bu:
+                c_cap = min(_next_pow2(max(nunv_chip, 2)), cap_b)
+                bu_caps = {"c_cap": c_cap}
+                dist_m, st = bu(dist, dev_scalar(level), dstT_sh,
+                                colstart_sh, degc_sh, degc, lo_sh,
+                                hi_sh, c_cap=c_cap, found_cap=found_cap,
+                                n_=n, b_max=b_max)
+            else:
+                f_cap = min(_next_pow2(max(f_count, 2)), cap_n)
+                # p_cap covers the heaviest single chip's OWNED share
+                # of the frontier mass (each vertex expands on its
+                # owner only)
+                p_cap = min(_next_pow2(max(m8f_chip, 2)), cap_q)
+                dist_m, st = td(dist, st_dev, dev_scalar(level),
+                                dstT_sh, colstart_sh, degc_sh, degc,
+                                lo_sh, hi_sh, f_cap=f_cap, p_cap=p_cap,
+                                found_cap=found_cap, n_=n, b_max=b_max)
+            st_h = [int(x) for x in np.asarray(st)]
+            found_max = st_h[ST_FOUNDMAX]
             if found_max <= found_cap:
+                # commit the attempt's stats ONLY on acceptance — an
+                # overflowed attempt's readback must not leak into the
+                # retry's cap sizing (the retry re-runs THIS level and
+                # needs the level-entry f_count/m8f_chip/nunv_chip; a
+                # truncated candidate list from a clobbered cap loses
+                # discoveries silently)
+                (f_count, m8_f, m8_unvis, found_max, m8f_chip,
+                 nunv_chip) = st_h
                 break
             found_cap = _next_pow2(max(found_max, 2))
             retries += 1
         dist = dist_m
         st_dev = st
-        frontier = None
         LAST_EXCHANGE_CAPS.append(found_cap)
         LAST_PROFILE.append({
             "level": level, "mode": "bu" if use_bu else "td",
             "nf": f_count, "m8_f": m8_f,
             "found_max_per_chip": found_max, "found_cap": found_cap,
             "exchanged_ids": num_dev * found_cap, "retries": retries,
-            "bu_dispatches": len(bu_trail), "bu_trail": bu_trail})
+            "dispatches": 1 + retries,
+            "bu_dispatches": (1 + retries) if use_bu else 0,
+            "bu_trail": ([{"step": "bu_fused", **bu_caps,
+                           "retries": retries}] if use_bu else [])})
         found_guess = min(_next_pow2(max(4 * found_max, 4)), cap_n)
         level += 1
     out = dist[0, :n] if dist.ndim == 2 else dist[:n]

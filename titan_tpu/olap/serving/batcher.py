@@ -101,10 +101,34 @@ def _bfs_result(snap, dist_row: np.ndarray, levels: int, inf: int,
 
 class Batcher:
     """Stateless executor over leased snapshots (the scheduler owns the
-    queue, admission and leases)."""
+    queue, admission and leases).
 
-    def __init__(self, max_batch: int = 16):
+    Mesh-aware placement (ISSUE 13): with ``mesh`` set, batched BFS
+    cohorts run over the multi-device mesh — the leased snapshot's
+    chunked CSR is placed once per snapshot through
+    ``parallel/partition.place_batched_csr`` (edge image's chunk
+    columns sharded over ``"v"``, per-vertex arrays replicated, the
+    ``[K, n]`` dist sharded ``P(None, "v")`` with K replicated) and the
+    UNCHANGED batched kernels are GSPMD-partitioned from those
+    committed placements, so K-way plan amortization and sharding
+    compose. Live-overlay leases run unmeshed (the overlay's COO/
+    tombstone buffers belong to the single-device layout) — recorded
+    per group as ``meshed`` on the run span."""
+
+    def __init__(self, max_batch: int = 16, mesh=None):
         self.max_batch = max_batch
+        self.mesh = mesh
+
+    def would_mesh(self, kind: str, overlay) -> bool:
+        """THE meshed-execution predicate — the scheduler's per-device
+        HBM admission accounting queries this exact method, so the
+        bytes the ledger charges and the layout this batcher actually
+        uploads can never disagree (a forked copy relaxing one side
+        would over-commit real device HBM past the admission guard)."""
+        return (self.mesh is not None
+                and int(self.mesh.devices.size) > 1
+                and kind in BATCHABLE_KINDS
+                and (overlay is None or overlay.empty))
 
     # -- batched BFS --------------------------------------------------------
 
@@ -183,6 +207,14 @@ class Batcher:
         started = time.time()
         dropped = [None] * K    # terminal state decided at a boundary
         n = snap.n if hasattr(snap, "n") else snap["n"]
+        # mesh placement: overlay leases stay single-device (the
+        # overlay's device buffers belong to the unsharded layout);
+        # everything else runs over the mesh via the placed graph dict
+        target = snap
+        meshed = self.would_mesh("bfs", overlay)
+        if meshed:
+            from titan_tpu.parallel.partition import place_batched_csr
+            target = place_batched_csr(snap, self.mesh)
         # device-run spans (obs): one "run" per job covering the shared
         # level loop; per-level "round" children carry the job's OWN
         # frontier count — all host timestamps from the level callback
@@ -191,7 +223,9 @@ class Batcher:
                                 **({"overlay_edges": overlay.count,
                                     "overlay_tombs": overlay.tomb_count}
                                    if overlay is not None
-                                   and not overlay.empty else {}))
+                                   and not overlay.empty else {}),
+                                **({"meshed": int(self.mesh.devices.size)}
+                                   if meshed else {}))
                 if job.trace is not None else None
                 for job in runnable]
         # anchor AFTER the run spans open so the first round's window
@@ -241,7 +275,7 @@ class Batcher:
                          for j in runnable)
         try:
             dist, levels, completed = frontier_bfs_batched(
-                snap, sources, max_levels=int(
+                target, sources, max_levels=int(
                     runnable[0].spec.params.get("max_levels", 1000)),
                 on_level=on_level,
                 init_dist=init_dist, start_level=start_level,

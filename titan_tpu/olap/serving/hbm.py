@@ -42,6 +42,21 @@ def snapshot_csr_bytes(snap) -> int:
     return chunked_csr_bytes(snap.n, q_total)
 
 
+def meshed_snapshot_csr_bytes(snap, num_devices: int) -> int:
+    """PER-DEVICE bytes of a MESH-PLACED chunked CSR (ISSUE 13,
+    ``parallel/partition.place_batched_csr``): the ``dstT`` edge image
+    shards its chunk columns over the mesh — each device holds ~1/D of
+    it — while the per-vertex side arrays replicate. The ledger models
+    ONE device's HBM, so a mesh-placed cohort charges this, not the
+    whole image; that reduction is the memory half of why batching and
+    sharding compose."""
+    total = snapshot_csr_bytes(snap)
+    n = getattr(snap, "n", 0)
+    vert = 3 * 4 * (n + 1)                    # colstart/degc/deg
+    edges = max(total - vert, 0)
+    return int(vert + -(-edges // max(int(num_devices), 1)))
+
+
 class AdmissionError(RuntimeError):
     """The job's graph image cannot fit the HBM budget even after
     evicting every unpinned resident graph."""

@@ -52,8 +52,8 @@ from titan_tpu.traversal.olap_compile import FallbackToInterpreter
 
 __all__ = ["TraversalPlan", "PPRPlan", "compile_steps",
            "compile_traversal", "plan_from_wire", "traversal_from_plan",
-           "reversed_chunked_csr", "FallbackToInterpreter",
-           "DEFAULT_MAX_DEPTH"]
+           "reversed_chunked_csr", "hop_label_masks",
+           "FallbackToInterpreter", "DEFAULT_MAX_DEPTH"]
 
 #: default bounded-depth ceiling (LDBC IS3 is a 4-hop; anything deeper
 #: is an analytics job for the heavy queue, not a point query)
@@ -69,19 +69,37 @@ class TraversalPlan:
     """One compiled point query: fuses with plans sharing
     :meth:`fuse_key` (snapshot selection — direction + labels; DEPTH is
     NOT part of the key, shallower members deactivate early through the
-    kernel's per-job keep mask)."""
+    kernel's per-job keep mask).
+
+    Mixed-label chains (ISSUE 13): ``hop_labels`` — a per-hop tuple of
+    label tuples (length == depth) when the chain changes labels
+    between hops. ``labels`` is then the UNION (the snapshot the lane
+    leases) and each hop masks the union layout down to its own set
+    through the kernel's per-level slot bitmaps
+    (:func:`hop_label_masks` → ``frontier_bfs_batched(level_masks=)``).
+    Mixed chains fuse only with identical chains (the masks are shared
+    batch-wide), so ``hop_labels`` joins the fuse key."""
 
     start_ids: tuple
     direction: Direction
-    labels: Optional[tuple]          # None = all labels
+    labels: Optional[tuple]          # None = all labels (union if mixed)
     depth: int
     terminal: Union[str, tuple]      # "id" | "count" | ("values", key)
+    hop_labels: Optional[tuple] = None   # per-hop label tuples (mixed)
 
     def fuse_key(self) -> tuple:
-        return ("traverse", self.direction, self.labels)
+        return ("traverse", self.direction, self.labels,
+                self.hop_labels)
 
     def describe(self) -> str:
         hop = _NAME_OF_DIR[self.direction]
+        if self.hop_labels is not None:
+            hops = "".join(f".{hop}({','.join(ls)})"
+                           for ls in self.hop_labels)
+            term = self.terminal if isinstance(self.terminal, str) \
+                else f"values({self.terminal[1]})"
+            return (f"V({','.join(str(i) for i in self.start_ids)})"
+                    f"{hops}.dedup().{term}")
         labs = ",".join(self.labels) if self.labels else ""
         term = self.terminal if isinstance(self.terminal, str) \
             else f"values({self.terminal[1]})"
@@ -159,11 +177,21 @@ def compile_steps(steps: list,
         return None
     directions = {h[0] for h in hops}
     label_sets = {h[1] for h in hops}
-    if len(directions) != 1 or len(label_sets) != 1:
-        # mixed directions / per-hop label changes would need a
-        # different CSR orientation or label mask PER LEVEL — the
-        # interpreter's job
+    if len(directions) != 1:
+        # mixed directions would need a different CSR orientation per
+        # level — the interpreter's job
         return None
+    hop_labels = None
+    if len(label_sets) != 1:
+        # per-hop label changes compile since ISSUE 13: lease the
+        # UNION-label snapshot and mask each level down to its hop's
+        # set through the kernel's per-level slot bitmaps — but an
+        # all-labels hop (empty set) inside a labeled chain would need
+        # the unfiltered snapshot, whose extra edges no union lease
+        # carries; that stays with the interpreter
+        if any(not h[1] for h in hops):
+            return None
+        hop_labels = tuple(h[1] for h in hops)
     if i >= len(steps) or steps[i][0] != "dedup":
         # no terminal dedup = path-multiplicity semantics, which a
         # frontier SET machine cannot carry (olap_compile's count
@@ -182,9 +210,13 @@ def compile_steps(steps: list,
         terminal = ("values", args[0][0])
     else:
         return None
-    labels = label_sets.pop() or None
+    if hop_labels is not None:
+        labels = tuple(sorted({name for ls in hop_labels
+                               for name in ls}))
+    else:
+        labels = label_sets.pop() or None
     return TraversalPlan(tuple(steps[0][1]), directions.pop(), labels,
-                         len(hops), terminal)
+                         len(hops), terminal, hop_labels=hop_labels)
 
 
 def compile_traversal(t, max_depth: int = DEFAULT_MAX_DEPTH
@@ -254,10 +286,34 @@ def plan_from_wire(body: dict):
     else:
         raise ValueError("terminal must be 'id', 'count' or "
                          "{'values': <key>}")
+    labels = body.get("labels")
+    hop_labels = None
+    if isinstance(labels, (list, tuple)) and labels \
+            and all(isinstance(x, (list, tuple)) for x in labels):
+        # per-hop label form: "labels": [["a"], ["b"]] — one label set
+        # per hop (the mixed-label chain seam, ISSUE 13)
+        if len(labels) != hops:
+            raise ValueError(
+                f"per-hop labels must list one set per hop "
+                f"({hops}), got {len(labels)}")
+        sets = []
+        for ls in labels:
+            if not ls or not all(isinstance(x, str) for x in ls):
+                raise ValueError(
+                    "each per-hop label set must be a non-empty list "
+                    f"of label names, got {ls!r}")
+            sets.append(tuple(ls))
+        if len(set(sets)) > 1:
+            hop_labels = tuple(sets)
+            wire_labels = tuple(sorted({n for ls in sets for n in ls}))
+        else:
+            wire_labels = sets[0]
+    else:
+        wire_labels = _wire_labels(body)
     return TraversalPlan(tuple(int(v) for v in start),
                          _DIR_NAMES[dir_name],
-                         _wire_labels(body),
-                         hops, terminal)
+                         wire_labels,
+                         hops, terminal, hop_labels=hop_labels)
 
 
 def _wire_labels(body: dict) -> Optional[tuple]:
@@ -280,9 +336,13 @@ def traversal_from_plan(plan: TraversalPlan, g):
     t = g.V(*plan.start_ids)
     step = {"out": "out", "in": "in_", "both": "both"}[
         _NAME_OF_DIR[plan.direction]]
-    labels = plan.labels or ()
-    for _ in range(plan.depth):
-        t = getattr(t, step)(*labels)
+    if plan.hop_labels is not None:
+        for ls in plan.hop_labels:
+            t = getattr(t, step)(*ls)
+    else:
+        labels = plan.labels or ()
+        for _ in range(plan.depth):
+            t = getattr(t, step)(*labels)
     t = t.dedup()
     if plan.terminal == "count":
         return t.count()
@@ -328,3 +388,78 @@ def reversed_chunked_csr(snap) -> dict:
     }
     snap._hybrid_csr_rev = out
     return out
+
+
+# -- per-hop label masks (mixed-label chains, ISSUE 13) -----------------------
+
+
+def hop_label_masks(snap, plan: TraversalPlan, direction) -> list:
+    """Per-hop edge-slot bitmaps for a mixed-label chain over the
+    UNION-label lease: hop h's bitmap sets the bit of every slot whose
+    edge label is NOT in hop h's set (1 = not a parent this level —
+    the same packing as the overlay tombstone bitmap, byte = chunk
+    column / bit = lane), ready for
+    ``frontier_bfs_batched(level_masks=)``.
+
+    Built on whichever layout the chain sweeps — the forward chunked
+    CSR (``both``/``in_``: payload in ``out_csr`` order, labels
+    permuted through the cached ``_out_csr_order``) or the REVERSED
+    layout (``out()``: payload in the snapshot's native dst-sorted
+    order, labels align directly). Hops sharing a label set share one
+    bitmap; masks cache on the snapshot per (direction, hop chain) and
+    upload once (the devprof ``interactive.label_masks`` H2D site).
+
+    Raises FallbackToInterpreter when the lease carries no label codes
+    (an unlabeled snapshot cannot answer a label-filtered chain
+    faithfully)."""
+    if snap.labels is None:
+        raise FallbackToInterpreter(
+            "mixed-label chain over a snapshot without label codes")
+    cache = getattr(snap, "_hop_label_masks", None)
+    if cache is None:
+        cache = snap._hop_label_masks = {}
+    key = (direction, plan.hop_labels)
+    got = cache.get(key)
+    if got is not None:
+        return got
+    import jax.numpy as jnp
+
+    n = snap.n
+    from titan_tpu.models.bfs_hybrid import layout_slot_positions
+    if direction is Direction.OUT:
+        # reversed layout: payload is snap.src in native dst-sorted
+        # order — labels align 1:1
+        deg = np.diff(snap.indptr_in).astype(np.int64)
+        pos, colstart, _degc = layout_slot_positions(
+            snap.indptr_in, deg, n)
+        labs = snap.labels
+    else:
+        _dst_by_src, indptr_out = snap.out_csr()
+        deg = snap.out_degree.astype(np.int64)
+        pos, colstart, _degc = layout_slot_positions(
+            indptr_out, deg, n)
+        labs = snap.labels[snap._out_csr_order]
+    q_total = int(colstart[-1]) + 1
+    name_of = snap.label_names
+    code_of = {v: k for k, v in name_of.items()}
+    masks: list = []
+    by_set: dict = {}
+    total_bytes = 0
+    for ls in plan.hop_labels:
+        dev = by_set.get(ls)
+        if dev is None:
+            codes = [code_of[name] for name in ls if name in code_of]
+            dead = ~np.isin(labs, np.asarray(codes, np.int32))
+            tomb = np.zeros(q_total, np.uint8)
+            p = pos[dead]
+            np.bitwise_or.at(tomb, p >> 3,
+                             np.uint8(1) << (p & 7).astype(np.uint8))
+            dev = jnp.asarray(tomb)
+            by_set[ls] = dev
+            total_bytes += tomb.nbytes
+        masks.append(dev)
+    if total_bytes:
+        from titan_tpu.obs import devprof
+        devprof.count_h2d("interactive.label_masks", total_bytes)
+    cache[key] = masks
+    return masks

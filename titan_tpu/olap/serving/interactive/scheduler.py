@@ -294,6 +294,16 @@ class InteractiveLane:
                 raise FallbackToInterpreter(
                     "directed chain over a live overlay: the overlay "
                     "seam serves the symmetrized (both) orientation")
+            if overlay is not None and plan0.hop_labels is not None:
+                # per-level label masks ride the tombstone-bitmap seam,
+                # and the overlay's add-COO edges carry labels the slot
+                # mask cannot filter — mixed-label chains under live
+                # writes fall back LOUDLY (frontier_bfs_batched raises
+                # on the combination too; this keeps the error a
+                # fallback, not a batch failure)
+                raise FallbackToInterpreter(
+                    "mixed-label chain over a live overlay: compact "
+                    "the overlay first")
             epoch_info = lease.epoch_info \
                 or {"epoch": getattr(snap, "epoch", 0)}
             # seeds: V(ids) skips unknown vertices, like the
@@ -345,6 +355,14 @@ class InteractiveLane:
             g = reversed_chunked_csr(snap) \
                 if direction is Direction.OUT \
                 else build_chunked_csr(snap)
+            # mixed-label chain (ISSUE 13): per-hop slot bitmaps over
+            # the union-label lease — one bitmap per distinct hop label
+            # set, threaded through the kernels as per-level masks
+            level_masks = None
+            if plan0.hop_labels is not None:
+                from titan_tpu.olap.serving.interactive.compile import \
+                    hop_label_masks
+                level_masks = hop_label_masks(snap, plan0, direction)
             # per-tenant HBM accounting, exactly like the heavy
             # queue: the image bytes are HELD against each member's
             # tenant while the run is in flight (the max_hbm_bytes
@@ -355,7 +373,8 @@ class InteractiveLane:
             t0 = time.time()
             try:
                 self._sweep(runnable, seeds, g, overlay, snap,
-                            batch_id, len(members), epoch_info)
+                            batch_id, len(members), epoch_info,
+                            level_masks=level_masks)
             finally:
                 wall = time.time() - t0
                 for r in runnable:
@@ -366,7 +385,7 @@ class InteractiveLane:
             return True
 
     def _sweep(self, runnable, seeds, g, overlay, snap, batch_id,
-               fused_k, epoch_info) -> None:
+               fused_k, epoch_info, level_masks=None) -> None:
         import jax.numpy as jnp
 
         from titan_tpu.models.bfs import _next_pow2
@@ -396,7 +415,7 @@ class InteractiveLane:
             dist, _levels, _completed = frontier_bfs_batched(
                 g, srcs, max_levels=D + 1, start_level=1,
                 on_level=on_level, overlay=overlay, mode="hops",
-                return_device=True)
+                level_masks=level_masks, return_device=True)
         else:
             # multi-start members (V(id1, id2, ...)): rarer — pay the
             # dense init upload
@@ -406,7 +425,8 @@ class InteractiveLane:
             dist, _levels, _completed = frontier_bfs_batched(
                 g, [0] * Kp, max_levels=D + 1, start_level=1,
                 init_dist=init, on_level=on_level, overlay=overlay,
-                mode="hops", return_device=True)
+                mode="hops", level_masks=level_masks,
+                return_device=True)
         # hop-set extraction stays DEVICE-side: one [Kp] size readback,
         # then a compacted index list per id/values member — never the
         # O(n) dist row (a scale-26 row is ~270 MB through the tunnel)

@@ -109,6 +109,7 @@ class JobScheduler:
     """One queue + one worker over one graph (or fixed snapshot)."""
 
     def __init__(self, graph=None, snapshot=None, *, max_batch: int = 16,
+                 mesh=None,
                  hbm_budget_bytes: float = DEFAULT_BUDGET_BYTES,
                  metrics: Optional[MetricManager] = None,
                  autostart: bool = True,
@@ -195,7 +196,14 @@ class JobScheduler:
             # actually drops the device arrays
             live._on_resident = (
                 lambda snap: self._evictable.setdefault(id(snap), snap))
-        self.batcher = Batcher(max_batch=max_batch)
+        # mesh-aware batch placement (ISSUE 13): with a multi-device
+        # mesh, batched BFS cohorts place their [K, n] state sharded
+        # over "v" (K replicated) and the edge image's chunk columns
+        # shard over the mesh — parallel/partition.place_batched_csr;
+        # the HBM ledger (a PER-DEVICE budget) then charges the
+        # per-device share (hbm.meshed_snapshot_csr_bytes)
+        self.mesh = mesh
+        self.batcher = Batcher(max_batch=max_batch, mesh=mesh)
         self.max_batch = max_batch
         # (self._metrics was bound before the recorder/profiler above)
         # tenancy plane (olap/serving/tenants): authoritative per-tenant
@@ -538,6 +546,8 @@ class JobScheduler:
         """The scheduler's effective configuration for the bundle —
         enough to reproduce the serving posture without the process."""
         return {"max_batch": self.max_batch,
+                "mesh_devices": int(self.mesh.devices.size)
+                if self.mesh is not None else None,
                 "hbm_budget_bytes": self.ledger.budget_bytes,
                 "tracing": self.tracer.enabled,
                 "profiling": self.profiler is not None,
@@ -891,8 +901,26 @@ class JobScheduler:
             for job in group:
                 job.ran_epoch = epoch_info
             ledger_key = id(snap)
+            # mesh-placed cohorts charge the PER-DEVICE share (the
+            # edge image shards over the mesh — hbm.meshed_snapshot_
+            # csr_bytes); only batched BFS runs meshed (single-run
+            # kinds and overlay leases keep the single-device layout).
+            # The predicate is the BATCHER's (Batcher.would_mesh) —
+            # the accounting here and the placement there must answer
+            # from one definition. A snapshot already resident under
+            # the other accounting keeps its first byte count
+            # (reserve() pins existing keys without re-pricing) —
+            # conservative either way.
+            meshed = self.batcher.would_mesh(spec.kind, overlay)
+            if meshed:
+                from titan_tpu.olap.serving.hbm import \
+                    meshed_snapshot_csr_bytes
+                nbytes = meshed_snapshot_csr_bytes(
+                    snap, int(self.mesh.devices.size))
+            else:
+                nbytes = snapshot_csr_bytes(snap)
             try:
-                self.ledger.reserve(ledger_key, snapshot_csr_bytes(snap))
+                self.ledger.reserve(ledger_key, nbytes)
             except AdmissionError as e:
                 for job in group:
                     job.fail(str(e))
@@ -903,7 +931,6 @@ class JobScheduler:
             # duration of the run — the live view max_hbm_bytes quotas
             # check against — then released and converted into
             # byte-seconds attribution
-            nbytes = snapshot_csr_bytes(snap)
             share = nbytes / len(group)
             for job in group:
                 self.tenants.hold_hbm(job.tenant, share)

@@ -188,10 +188,11 @@ def _worker(coordinator: str, num_processes: int, process_id: int,
         from titan_tpu.models import bfs_hybrid_sharded as S
         ref, _ = frontier_bfs_hybrid(snap, source)
         ok = bool((dist == np.asarray(ref)).all())
-        # bottom-up levels must run through the HOST-DRIVEN
-        # bu0/bu_more/exhaust path on the process-spanning mesh too
-        # (r4 kept a fused full-width DCN fallback measured 52x slower;
-        # it is deleted — this records the proof)
+        # bottom-up levels must run through the FUSED shx_bu path on
+        # the process-spanning mesh too (ISSUE 13: the r4 host-driven
+        # bu0/bu_more/exhaust chain is deleted, as was r4's fused
+        # full-width DCN fallback before it — this records the proof
+        # that DCN meshes run the same one-dispatch-per-level kernels)
         bu_levels = [p for p in S.LAST_PROFILE if p["mode"] == "bu"]
         print("MULTIHOST_OK " + json.dumps({
             "processes": num_processes,
@@ -200,13 +201,16 @@ def _worker(coordinator: str, num_processes: int, process_id: int,
             "scale": scale, "levels": levels,
             "reached": int((dist < (1 << 30)).sum()),
             "bit_equal_vs_single_chip": ok,
-            "bu_levels_host_driven": len(bu_levels),
+            "bu_levels_fused": len(bu_levels),
+            "dispatches_per_level_max":
+                max((p["dispatches"] for p in S.LAST_PROFILE),
+                    default=0),
             "bu_trails": [p["bu_trail"] for p in bu_levels]}),
             flush=True)
         # exit status gates on bit-correctness ONLY: whether any level
         # ran bottom-up is the direction heuristic's call (a scale or
         # degree distribution that stays top-down throughout is still
-        # a correct run) — bu_levels_host_driven above is the evidence
+        # a correct run) — bu_levels_fused above is the evidence
         # the driver inspects instead (ADVICE r5 #1)
         if not ok:
             raise SystemExit(2)
