@@ -739,8 +739,13 @@ def tenancy_stage(rep: Report, scale: int) -> None:
     snap = snap_mod.from_arrays(n, np.concatenate([src, dst]),
                                 np.concatenate([dst, src]))
     metrics = MetricManager()        # isolated: bench-only lines
+    # autotune defaults to SHADOW — the stage leaves it there and
+    # drives one explicit post-load tick so the evidence bundle gets a
+    # real journaled decision from real signals (the tick interval is
+    # parked high so the worker loop doesn't consume the occupancy
+    # delta first)
     sched = JobScheduler(snapshot=snap, metrics=metrics,
-                         autostart=False)
+                         autostart=False, autotune_tick_s=3600.0)
     try:
         # interleaved submits: alpha floods 12 jobs, beta sends 4 —
         # fused batches mix tenants, which is exactly what the per-K
@@ -781,7 +786,19 @@ def tenancy_stage(rep: Report, scale: int) -> None:
         labeled_sum = sum(
             c.count for _lbls, c in metrics.children(
                 "serving.jobs.completed"))
+        # ISSUE 14: one shadow-mode controller tick over the stage's
+        # real signals — the decision count + an example journal entry
+        # feed the --evidence roadmap5 `controller_decisions` line
+        controller = None
+        if sched.controller is not None:
+            sched.controller.tick(force=True)
+            journal = sched.controller.journal()
+            controller = {
+                "mode": sched.controller.mode,
+                "decisions": len(journal),
+                "example": journal[-1] if journal else None}
         rep.detail["tenancy"] = {
+            "controller": controller,
             "scale": scale, "edges_sym": 2 * m,
             "tenants": per_tenant,
             # roll-up exactness: the labeled children account for every
@@ -1230,7 +1247,17 @@ class Evidence:
                         if k.endswith("_sharded_1dev")), None)
         serving = det.get("serving")
         interactive = det.get("interactive")
+        tenancy = det.get("tenancy")
         return {
+            # ISSUE 14 (ROADMAP #4): the autotune decision plane — a
+            # shadow-mode run of the tenancy stage must produce a
+            # journaled, replayable decision; count + one example
+            # entry, or the stage's recorded skip reason
+            "controller_decisions": (
+                present(tenancy["controller"])
+                if tenancy is not None
+                and tenancy.get("controller") is not None
+                else absent("tenancy")),
             "sharded_bfs": (present(sharded) if sharded is not None
                             else absent("bfs23_sharded")),
             # ISSUE 13 (ROADMAP #1): the 1-device sharding-overhead

@@ -23,8 +23,10 @@ _DOC = os.path.join(_REPO, "docs", "monitoring.md")
 
 # quoted literal with >= 3 dot-components under a guarded family
 # prefix; {x} keeps f-string placeholders visible for template
-# expansion (device./flightrec. joined serving. in ISSUE 10)
-_FAMILIES = r"(?:serving|device|flightrec)"
+# expansion (device./flightrec. joined serving. in ISSUE 10;
+# controller./scan. in ISSUE 14 — the autotune decision plane and the
+# distributed-scan instrumentation)
+_FAMILIES = r"(?:serving|device|flightrec|controller|scan)"
 _LITERAL = re.compile(
     r"""["']f?(""" + _FAMILIES
     + r"""\.[a-z0-9_]+\.[a-z0-9_.{}]+)["']""")
@@ -96,8 +98,22 @@ def test_every_code_metric_documented_and_vice_versa():
                    "device.compile.", "device.exec.", "device.xfer.",
                    "flightrec.",
                    # ISSUE 11: the interactive point-query lane
-                   "serving.interactive."):
+                   "serving.interactive.",
+                   # ISSUE 14: the autotune decision plane + the
+                   # distributed-scan instrumentation
+                   "controller.", "scan.remote."):
         assert any(n.startswith(family) for n in code), (family, code)
+    # ISSUE 14: the controller's decision-flow surface must stay in
+    # the scan (created in olap/serving/autotune.py)
+    for name in ("controller.tick.count",
+                 "controller.decisions.applied",
+                 "controller.decisions.shadowed",
+                 "controller.journal.dropped",
+                 "controller.knob.value",
+                 "scan.remote.splits_dispatched",
+                 "scan.remote.splits_redispatched",
+                 "scan.remote.worker_failures"):
+        assert name in code, name
     # ISSUE 11: the interactive lane's fuse/fallback evidence must stay
     # in the scan (created in olap/serving/interactive/scheduler.py)
     for name in ("serving.interactive.requests",

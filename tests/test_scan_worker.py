@@ -175,3 +175,55 @@ def test_factory_allowlist_is_dot_anchored():
     assert not w._factory_allowed("myjobs_evil:job")
     assert not w._factory_allowed("titan_tpu_evil.mod:job")
     assert not w._factory_allowed("os:system")
+
+
+def test_scan_metrics_and_spans_on_failover(cluster):
+    """ISSUE 14 satellite: the distributed scan path reports its split
+    flow — dispatched / merged / re-dispatched counters, per-{url}
+    worker failures, worker-side served counts — and (with a tracer)
+    one `split` span per attempt under the reserved "scan" trace id,
+    so a dead worker's re-dispatch is visible instead of hiding inside
+    a slower wall clock."""
+    from titan_tpu.obs.tracing import Tracer
+    from titan_tpu.utils.metrics import MetricManager
+
+    cfg, _stock = cluster
+    _populate(cfg, n_people=18, n_edges=9)
+    m = MetricManager()
+    live = ScanWorkerServer(metrics=m).start()
+    dead = ScanWorkerServer().start()
+    dead_addr = f"127.0.0.1:{dead.port}"
+    dead.stop()                     # worker 0 is a corpse
+    tracer = Tracer()
+    runner = RemoteScanRunner(
+        [dead_addr, f"127.0.0.1:{live.port}"], cfg,
+        splits_per_worker=2, metrics=m, tracer=tracer)
+    try:
+        got = runner.run(ScanJobSpec(
+            "titan_tpu.olap.jobs:make_vertex_count_job"))
+        assert got.get(VertexCountJob.VERTICES) == 18
+        # 4 splits total; the corpse's first split re-dispatched to the
+        # survivor, which served every split
+        assert m.counter_value("scan.remote.splits_merged") == 4
+        assert m.counter_value("scan.remote.splits_served") == 4
+        assert m.counter_value("scan.remote.splits_redispatched") == 1
+        # dispatched counts attempts: 4 merges + the failed one
+        assert m.counter_value("scan.remote.splits_dispatched") == 5
+        assert m.counter_value(
+            "scan.remote.worker_failures",
+            labels={"url": f"http://{dead_addr}"}) == 1
+        # one completed span per attempt under the reserved "scan" id
+        spans = tracer.spans("scan")
+        assert spans is not None and len(spans) == 5
+        assert all(s.name == "split" and s.t_end is not None
+                   for s in spans)
+        failed = [s for s in spans if s.attrs.get("redispatched")]
+        assert len(failed) == 1
+        assert failed[0].attrs["url"] == f"http://{dead_addr}"
+        assert "error" in failed[0].attrs
+        oks = [s for s in spans if s.attrs.get("ok")]
+        assert len(oks) == 4 and all(
+            s.attrs["url"] == f"http://127.0.0.1:{live.port}"
+            for s in oks)
+    finally:
+        live.stop()

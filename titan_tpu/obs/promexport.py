@@ -84,6 +84,26 @@ HELP = {
     "flightrec.ring.events": "events journaled into the flight ring",
     "flightrec.dump.written": "postmortem bundles written",
     "flightrec.dump.errors": "postmortem bundle writes that failed",
+    "controller.tick.count": "autotune controller evaluation ticks",
+    "controller.decisions.applied":
+        "enforced knob changes, by rule",
+    "controller.decisions.shadowed":
+        "decisions journaled without application (shadow mode), "
+        "by rule",
+    "controller.journal.dropped":
+        "decision-journal entries dropped past the bound",
+    "controller.knob.value":
+        "current autotuned knob value, by knob",
+    "scan.remote.splits_dispatched":
+        "scan splits shipped to HTTP scan workers",
+    "scan.remote.splits_merged":
+        "scan splits whose results merged successfully",
+    "scan.remote.splits_redispatched":
+        "scan splits re-queued after a worker failure",
+    "scan.remote.worker_failures":
+        "scan-worker retirements, by worker url",
+    "scan.remote.splits_served":
+        "splits executed on this scan-worker node",
 }
 
 _ILLEGAL = re.compile(r"[^a-zA-Z0-9_:]")

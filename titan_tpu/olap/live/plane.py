@@ -429,12 +429,23 @@ class LiveGraphPlane:
         """Force-fold the overlay (dense/PageRank's documented
         compact-before-run fallback). Returns True when a compaction
         happened."""
+        return self.compact_now(why="compact-before-run")
+
+    def compact_now(self, why: str = "controller") -> bool:
+        """Externally-triggered epoch fold — the autotune controller's
+        predicted-merge-cost seam (olap/serving/autotune): compact the
+        overlay NOW instead of waiting for the fixed fill/tombstone
+        thresholds. Pumps first so the fold covers every visible
+        commit; a clean overlay is a no-op. Returns True when a
+        compaction happened."""
         with self._lock:
+            if self._closed:
+                return False
             self._pump_local()
             self._pump_feed()
             if self.overlay.count == 0 and self.overlay.tomb_count == 0:
                 return False
-            self._compact([], why="compact-before-run")
+            self._compact([], why=why)
             return True
 
     def _resync(self, why: str) -> None:

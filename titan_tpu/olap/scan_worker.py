@@ -15,6 +15,16 @@ Workers open their own graph connection per request from the shipped
 config, exactly like HadoopScanMapper.setup reconstructs the job from
 serialized config; pointing that config at a ``remote``/``remote-cluster``
 backend gives a true multi-host scan against shared storage nodes.
+
+Observability (ISSUE 14 satellite): the path used to merge
+``ScanMetrics`` and say nothing else — a dead worker's splits were
+silently re-dispatched. It now reports through the registry
+(``scan.remote.*``, docs/monitoring.md — visible on ``GET /metrics``):
+splits dispatched / merged / re-dispatched, per-``{url}`` worker
+failures, and splits served on the worker side; pass ``tracer=`` (an
+``obs.tracing.Tracer``) to additionally journal one span per split
+under the reserved trace id ``"scan"`` (url, key-range size, ok/error
+— the re-dispatch timeline end to end).
 """
 
 from __future__ import annotations
@@ -22,6 +32,7 @@ from __future__ import annotations
 import base64
 import queue
 import threading
+import time
 from typing import Optional, Sequence
 
 from titan_tpu.errors import PermanentBackendError, TemporaryBackendError
@@ -29,6 +40,7 @@ from titan_tpu.olap.api import ScanMetrics
 from titan_tpu.olap.distributed import (ScanJobSpec, _merge_metrics,
                                         _run_split, key_splits)
 from titan_tpu.utils.httpnode import JsonNode, json_call
+from titan_tpu.utils.metrics import MetricManager
 
 
 def _b(x: bytes) -> str:
@@ -52,9 +64,11 @@ class ScanWorkerServer(JsonNode):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  auth_token: Optional[str] = None,
-                 factory_allow: Optional[Sequence[str]] = None):
+                 factory_allow: Optional[Sequence[str]] = None,
+                 metrics: Optional[MetricManager] = None):
         super().__init__(self._dispatch, host, port, name="scan-worker",
                          auth_token=auth_token)
+        self._metrics = metrics or MetricManager.instance()
         if factory_allow is None:
             import os
             extra = [p.strip() for p in
@@ -84,6 +98,7 @@ class ScanWorkerServer(JsonNode):
             counts = _run_split(dict(req["graph_config"]), spec, key_range,
                                 req.get("store", "edgestore"),
                                 int(req.get("num_threads", 2)))
+            self._metrics.counter("scan.remote.splits_served").inc()
             return {"counts": {k: int(v) for k, v in counts.items()}}
         raise ValueError(f"unknown path {path!r}")
 
@@ -94,7 +109,9 @@ class RemoteScanRunner:
 
     def __init__(self, workers: Sequence[str], graph_config: dict,
                  store: str = "edgestore", threads_per_worker: int = 2,
-                 splits_per_worker: int = 2, timeout: float = 600.0):
+                 splits_per_worker: int = 2, timeout: float = 600.0,
+                 metrics: Optional[MetricManager] = None,
+                 tracer=None):
         if not workers:
             raise ValueError("RemoteScanRunner needs at least one worker")
         self.workers = [w if "://" in w else f"http://{w}" for w in workers]
@@ -103,6 +120,19 @@ class RemoteScanRunner:
         self.threads_per_worker = threads_per_worker
         self.splits_per_worker = splits_per_worker
         self.timeout = timeout
+        self._metrics = metrics or MetricManager.instance()
+        # optional span journal (obs/tracing.Tracer): one event per
+        # split attempt under the reserved "scan" trace id
+        self._tracer = tracer
+
+    def _split_event(self, url: str, t0: float, **attrs) -> None:
+        """One completed ``split`` span under the reserved ``"scan"``
+        trace id (when a tracer is attached) — dispatch→outcome wall
+        time with the worker url, so a dead worker's re-dispatch is a
+        visible timeline, not an inference from totals."""
+        if self._tracer is not None:
+            self._tracer.event("scan", "split", t0=t0, t1=time.time(),
+                               url=url, **attrs)
 
     def run(self, spec: ScanJobSpec, idm=None) -> ScanMetrics:
         if idm is None:
@@ -133,11 +163,14 @@ class RemoteScanRunner:
             failure (re-run-mapper semantics). A PermanentBackendError is
             the JOB's fault (e.g. an unresolvable factory) — retrying on
             other workers cannot help, so the whole run aborts."""
+            m = self._metrics
             while not done.is_set():
                 try:
                     key_range = pending.get(timeout=0.2)
                 except queue.Empty:
                     continue
+                m.counter("scan.remote.splits_dispatched").inc()
+                t0 = time.time()
                 try:
                     res = json_call(url, "/scan", {
                         "graph_config": self.graph_config,
@@ -148,18 +181,30 @@ class RemoteScanRunner:
                         "num_threads": self.threads_per_worker,
                     }, timeout=self.timeout)
                 except PermanentBackendError as e:
+                    self._split_event(url, t0, error=f"permanent: {e}")
                     with lock:
                         fatal.append(e)
                         done.set()
                     return
                 except Exception as e:   # noqa: BLE001 — retire worker
+                    # the split is idempotent: back on the queue for a
+                    # survivor — COUNTED, so a flapping worker's
+                    # re-dispatch churn shows on GET /metrics instead
+                    # of hiding inside a slower wall clock
                     pending.put(key_range)
+                    m.counter("scan.remote.splits_redispatched").inc()
+                    m.counter("scan.remote.worker_failures",
+                              labels={"url": url}).inc()
+                    self._split_event(url, t0, redispatched=True,
+                                      error=f"{type(e).__name__}: {e}")
                     with lock:
                         errors.append(e)
                         alive[0] -= 1
                         if alive[0] == 0:
                             done.set()   # no one left to drain the queue
                     return
+                m.counter("scan.remote.splits_merged").inc()
+                self._split_event(url, t0, ok=True)
                 with lock:
                     results.append(res["counts"])
                     remaining[0] -= 1

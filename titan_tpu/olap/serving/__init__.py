@@ -19,6 +19,13 @@ admission-controlled asynchronous job plane over the TPU engine:
 * ``scheduler`` — priority queue + admission + worker, with per-job
                   latency / queue-depth / batch-occupancy metrics
                   through utils/metrics.
+* ``autotune``  — the closed-loop decision plane (ROADMAP #4): a
+                  per-scheduler Controller ticks over the metric/SLO
+                  registries and journals bounded, replayable knob
+                  decisions (batch K, tenant quota scaling, compaction
+                  triggers, checkpoint cadence); shadow by default,
+                  ``autotune="enforce"`` applies them.
+                  ``GET /controller`` serves the journal.
 * ``tenants``   — per-tenant resource attribution (queue-ms /
                   device-seconds / HBM byte-seconds / replayed rounds)
                   and quota admission (``TenantQuota``, enforced at

@@ -160,8 +160,13 @@ class InteractiveLane:
         ``tenants.finished``."""
         tenant = effective_tenant(tenant)
         sched = self.sched
-        why = sched.tenants.admit(tenant, sched.quotas.get(tenant),
-                                  sched.enforce_quotas)
+        # an enforcing autotune controller's tenant shed scales the
+        # configured quota HERE too — a shed tenant must not dodge the
+        # throttle by switching its flood to point queries
+        quota = sched.quotas.get(tenant)
+        if sched.controller is not None:
+            quota = sched.controller.scaled_quota(tenant, quota)
+        why = sched.tenants.admit(tenant, quota, sched.enforce_quotas)
         if why is not None and sched.enforce_quotas:
             self._metrics.counter("serving.tenant.rejected",
                                   labels={"tenant": tenant}).inc()

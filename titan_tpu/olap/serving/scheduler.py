@@ -66,6 +66,14 @@ HBM byte-seconds / replayed-rounds per tenant (``tenant_stats()`` →
 throttled — observable-first); ``slos=[obs.slo.SLO(...)]`` attaches the
 SLO engine (``slo_report()`` → ``GET /slo``, burn-rate gauges).
 
+Autotuning (olap/serving/autotune, ROADMAP #4): a ``Controller`` owned
+by this scheduler reads the registries above on a fixed tick and
+journals bounded knob decisions (batch K, tenant quota scaling,
+compaction triggers, checkpoint cadence). Shadow by default —
+``autotune="enforce"`` / TITAN_TPU_AUTOTUNE=enforce lets them move the
+knobs; ``autotune="off"`` removes the plane. ``GET /controller`` serves
+the journal; ``controller.*`` metric families export the decision flow.
+
 Tracing (titan_tpu/obs, ISSUE r10): one trace per job (trace id ==
 job id) — ``submit`` / ``queue`` / per-attempt ``attempt`` spans open
 here; ``fuse`` / ``run`` / per-round ``round`` / ``checkpoint`` spans
@@ -125,7 +133,11 @@ class JobScheduler:
                  flight_capacity: int = 4096,
                  interactive_window_s: Optional[float] = None,
                  interactive_max_fuse: Optional[int] = None,
-                 interactive_max_depth: Optional[int] = None):
+                 interactive_max_depth: Optional[int] = None,
+                 autotune: Optional[str] = None,
+                 autotune_tick_s: Optional[float] = None,
+                 autotune_clock=None,
+                 autotune_params: Optional[dict] = None):
         # observability plane (titan_tpu/obs): one tracer per scheduler,
         # one trace per job (trace id == job id) — submit/queue/attempt
         # spans here, fuse/run/round/checkpoint spans in the batcher &
@@ -238,6 +250,26 @@ class JobScheduler:
             self.slo = SLOEngine(self._metrics, slos,
                                  clock=slo_clock)
             self.slo.register_gauges()
+        # closed-loop autotuning (olap/serving/autotune, ROADMAP #4):
+        # the controller reads its signals off THIS scheduler's
+        # registries on a fixed tick (driven from the worker loop) and
+        # journals bounded, hysteresis-guarded knob decisions. Shadow
+        # mode is the default — decisions are computed and journaled
+        # but nothing moves; autotune="enforce" (or
+        # TITAN_TPU_AUTOTUNE=enforce) lets them drive batch K, tenant
+        # quota scaling, compaction triggers and checkpoint cadence.
+        # autotune="off" removes the plane (no controller.* metrics).
+        self.controller = None
+        self._ctl_stitch_seq = 0
+        if autotune is None:
+            autotune = os.environ.get("TITAN_TPU_AUTOTUNE")
+        from titan_tpu.olap.serving.autotune import resolve_mode
+        mode = resolve_mode(autotune)
+        if mode != "off":
+            from titan_tpu.olap.serving.autotune import Controller
+            self.controller = Controller(
+                self, mode=mode, tick_s=autotune_tick_s,
+                clock=autotune_clock, **(autotune_params or {}))
         # recovery plane: one store for every job's checkpoints, keyed
         # by a per-scheduler nonce + job id (job ids restart at job-1
         # per process while the store persists on disk — a restarted
@@ -333,6 +365,8 @@ class JobScheduler:
                 g.set(0.0)
         if self.slo is not None:
             self.slo.detach_gauges()
+        if self.controller is not None:
+            self.controller.detach_gauges()
         # detach OUR process-wide profiler (a caller-provided one stays
         # the caller's to uninstall)
         if self._own_profiler and self.profiler is not None:
@@ -401,9 +435,13 @@ class JobScheduler:
         # limit must not both read "below limit" and both admit).
         # Enforcement is flagged, default off — a violating submit in
         # shadow mode is admitted but counted, so admission control
-        # lands observable-first
-        why = self.tenants.admit(tenant, self.quotas.get(tenant),
-                                 self.enforce_quotas)
+        # lands observable-first. An ENFORCING autotune controller may
+        # scale the configured quota down (tenant shedding) — the gate
+        # checks the scaled limit, the journal explains why.
+        quota = self.quotas.get(tenant)
+        if self.controller is not None:
+            quota = self.controller.scaled_quota(tenant, quota)
+        why = self.tenants.admit(tenant, quota, self.enforce_quotas)
         if why is not None:
             if self.enforce_quotas:
                 self._metrics.counter("serving.tenant.rejected",
@@ -431,13 +469,21 @@ class JobScheduler:
                                      tenant=job.tenant)
             job.trace = TraceHandle(self.tracer, job.id, root)
             job.trace.event("submit", parent=root)
+        # checkpoint cadence: the spec's own setting wins; a retryable
+        # job that did not pick one adopts the autotune controller's
+        # measured-cost cadence when enforcement is on (hint() is 0
+        # otherwise — shadow mode never changes capture behavior)
+        every = spec.checkpoint_every
+        if every <= 0 and spec.max_retries > 0 \
+                and self.controller is not None:
+            every = self.controller.checkpoint_every_hint()
         store = self.ckpt_store \
-            if self.ckpt_store is not None and spec.checkpoint_every > 0 \
+            if self.ckpt_store is not None and every > 0 \
             else None
         if store is not None or faults is not None:
             from titan_tpu.olap.recovery import JobRecovery
             job.recovery = JobRecovery(
-                store, job, every=spec.checkpoint_every, faults=faults,
+                store, job, every=every, faults=faults,
                 metrics=self._metrics,
                 key=f"{self._ckpt_ns}-{job.id}" if store is not None
                 else None)
@@ -553,6 +599,8 @@ class JobScheduler:
                 "profiling": self.profiler is not None,
                 "checkpoints": self.ckpt_store is not None,
                 "live": self.live is not None,
+                "autotune": self.controller.mode
+                if self.controller is not None else "off",
                 "enforce_quotas": self.enforce_quotas,
                 "quotas": {t: q.to_wire()
                            for t, q in sorted(self.quotas.items())}}
@@ -575,7 +623,12 @@ class JobScheduler:
                            "pinned_bytes": self.ledger.pinned_bytes(),
                            "budget_bytes": self.ledger.budget_bytes},
                        "pool": self.pool.stats(),
-                       "live": self.live_stats()},
+                       "live": self.live_stats(),
+                       # the decision journal rides in every bundle:
+                       # a postmortem must show what the controller
+                       # was doing to the knobs beforehand
+                       "controller": self.controller.state()
+                       if self.controller is not None else None},
                 config=self._dump_config(),
                 profiler=self.profiler)
         except Exception:
@@ -755,8 +808,22 @@ class JobScheduler:
 
     def _run(self) -> None:
         while True:
+            # autotune tick (olap/serving/autotune): evaluated on the
+            # worker thread between batches — the same thread that owns
+            # max_batch, so K moves race nothing. Nothing the
+            # controller does may take the worker down.
+            if self.controller is not None:
+                try:
+                    self.controller.maybe_tick()
+                except Exception:
+                    pass
             with self._cv:
-                while not self._stop and not self._heap:
+                # bounded single wait, NOT a drain-the-heap loop: an
+                # idle scheduler must keep cycling through the
+                # controller tick above (restores fire when traffic
+                # STOPS — the empty-queue state is a control signal,
+                # not a reason to sleep forever)
+                if not self._stop and not self._heap:
                     self._cv.wait(0.1)
                 if self._stop:
                     return
@@ -864,6 +931,25 @@ class JobScheduler:
                 self.tenants.queue_ms(job.tenant, q * 1e3)
         self._metrics.histogram("serving.batch.occupancy").update(
             float(len(group)))
+        # decision spans (olap/serving/autotune): jobs executing under
+        # freshly-APPLIED controller decisions carry them in their
+        # traces — the "why did my batch shape change" evidence.
+        # Enforce mode only: shadow decisions stay journal/
+        # `controller`-trace-only (an unapplied decision affected no
+        # job, and the default-shadow hot path must not re-scan the
+        # journal per batch for nothing).
+        if self.controller is not None \
+                and self.controller.mode == "enforce":
+            decs = [d for d in self.controller.decisions_since(
+                self._ctl_stitch_seq) if d["applied"]]
+            if decs:
+                self._ctl_stitch_seq = decs[-1]["seq"]
+                brief = [{k: d[k] for k in ("seq", "rule", "knob",
+                                            "old", "new")}
+                         for d in decs]
+                for job in group:
+                    if job.trace is not None:
+                        job.trace.event("controller", decisions=brief)
         if head.spec.kind == "callable":
             t0 = time.time()
             for job in group:

@@ -81,6 +81,16 @@ Endpoints:
                       current SLI and multi-window error-budget burn
                       rates; {"enabled": false} when the scheduler has
                       no objectives attached
+  GET  /controller  — the autotune decision plane (olap/serving/
+                      autotune, ROADMAP #4): mode (shadow/enforce),
+                      current knob values (batch K target, per-tenant
+                      quota scales, checkpoint cadence), armed
+                      cooldowns, and the bounded decision journal —
+                      each entry carries the signal snapshot it read,
+                      the rule id, old→new and its cooldown, so every
+                      decision is reconstructible from the entry
+                      alone; {"enabled": false} without a live
+                      scheduler or with autotune="off"
   GET  /healthz     — liveness + readiness (ISSUE 10, the health-check
                       hook a replica fleet needs): 200 when ready, 503
                       with per-check detail otherwise. Ready ⇔ the
@@ -571,6 +581,19 @@ class GraphServer:
                         self._send(200, {"enabled": False})
                     else:
                         self._send(200, {"enabled": True, **live})
+                elif self.path == "/controller":
+                    # autotune decision plane (olap/serving/autotune):
+                    # knob state + the explainable decision journal —
+                    # answered from the LIVE scheduler only (a probe
+                    # must not construct one; cf. /tenants)
+                    sched = server.live_scheduler()
+                    ctl = sched.controller if sched is not None \
+                        else None
+                    if ctl is None:
+                        self._send(200, {"enabled": False})
+                    else:
+                        self._send(200, {"enabled": True,
+                                         **ctl.state()})
                 elif self.path == "/tenants":
                     # per-tenant attribution + quota view (ISSUE 8):
                     # accounting rows, configured quotas, enforcement —

@@ -516,6 +516,16 @@ class MetricManager:
             out[n] = {"value": value, "own": own, "children": kids}
         return out
 
+    def histogram_stats(self, name: str) -> Optional[dict]:
+        """Non-creating histogram read: ``to_dict()`` or None when the
+        name was never recorded. Signal READERS (the autotune
+        controller, diagnostics) use this instead of ``histogram()`` —
+        observation must not mint registry entries as a side effect,
+        or a shadow-mode observer would perturb the very snapshot it
+        is compared against."""
+        h = self._histograms.get(name)
+        return h.to_dict() if h is not None else None
+
     def timer_count(self, name: str) -> int:
         t = self._timers.get(name)
         return t.count if t is not None else 0
