@@ -1231,6 +1231,27 @@ class Evidence:
             entry["device_cost"] = window_delta
         self.stages[name] = entry
 
+    def _lint_clean(self) -> dict:
+        """ISSUE 15: chip-day bundles record that the static invariants
+        (op-scan ban, host-sync, lock-discipline, metric/clock
+        discipline — docs/static-analysis.md) held for the exact tree
+        that produced the numbers — a value, or a recorded skip."""
+        try:
+            repo = os.path.dirname(os.path.abspath(__file__))
+            if repo not in sys.path:
+                sys.path.insert(0, repo)
+            from tools.graftlint.engine import Linter
+            res = Linter(root=repo).run(["titan_tpu", "bench.py"])
+            return {"present": True, "value": {
+                "clean": not res.unsuppressed,
+                "unsuppressed": len(res.unsuppressed),
+                "suppressed": len(res.findings) - len(res.unsuppressed),
+                "files": len(res.files),
+                "wall_s": round(res.wall_s, 3)}}
+        except Exception as e:          # missing tools/ checkout etc.
+            return {"present": False, "stage": "lint",
+                    "skip_reason": f"graftlint unavailable: {e!r}"}
+
     def _checklist(self) -> dict:
         det = self.rep.detail
 
@@ -1249,6 +1270,8 @@ class Evidence:
         interactive = det.get("interactive")
         tenancy = det.get("tenancy")
         return {
+            # ISSUE 15: the invariants held for this tree (graftlint)
+            "lint_clean": self._lint_clean(),
             # ISSUE 14 (ROADMAP #4): the autotune decision plane — a
             # shadow-mode run of the tenancy stage must produce a
             # journaled, replayable decision; count + one example

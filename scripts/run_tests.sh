@@ -1,8 +1,14 @@
 #!/usr/bin/env bash
-# CI/bench test invocation: runs the default tier on 4 xdist workers
-# (687s -> 214s measured). The worker count lives HERE, not in
-# pyproject addopts, so a bare ``pytest`` works without pytest-xdist
-# (only declared in the optional [test] extra: pip install -e .[test]).
-# Override workers with PYTEST_WORKERS=N; extra args pass through.
+# CI/bench test invocation: graftlint first (fails fast in ~3s on any
+# invariant break — docs/static-analysis.md), then the default tier on
+# 4 xdist workers (687s -> 214s measured). The worker count lives
+# HERE, not in pyproject addopts, so a bare ``pytest`` works without
+# pytest-xdist (only declared in the optional [test] extra: pip
+# install -e .[test]). Override workers with PYTEST_WORKERS=N; extra
+# args pass through. SKIP_LINT=1 skips the standalone lint gate (the
+# invariants still run inside the suite as tests/test_lint.py).
 set -euo pipefail
+if [[ "${SKIP_LINT:-0}" != "1" ]]; then
+  "$(dirname "$0")/lint.sh"
+fi
 exec python -m pytest -n "${PYTEST_WORKERS:-4}" "$@"

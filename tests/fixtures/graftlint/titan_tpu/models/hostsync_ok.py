@@ -1,0 +1,45 @@
+"""R2 negative fixture: statics, shape metadata, and host-side code
+are all fair game."""
+
+import functools
+
+from titan_tpu.utils.jitcache import jit_once
+
+
+def good_kernel():
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnames=("n_", "wide"))
+        def kern(x, n_: int, wide: bool = False):
+            if wide:                     # static param: compile-time branch
+                x = x * 2
+            rows = int(x.shape[0])       # static metadata off a traced arg
+            pad = jnp.asarray(n_)        # jnp coercion stays on device
+            return jnp.where(x > 0, x, pad), rows
+
+        return kern
+
+    return jit_once("fixture_host_ok", build)
+
+
+def host_helper(arr):
+    """Not a registered kernel — plain host code may coerce freely."""
+    import numpy as np
+
+    return int(arr[0]) + float(np.asarray(arr).sum())
+
+
+def static_argnums_at_call_site():
+    """static_argnums on the registration-site jax.jit CALL (not a
+    decorator) must mark the positional param static too."""
+    import jax
+
+    def step(x, n):
+        if n > 3:                # n is static via static_argnums=(1,)
+            return x * n
+        return x
+
+    return jit_once("fixture_static_nums",
+                    lambda: jax.jit(step, static_argnums=(1,)))

@@ -1,0 +1,39 @@
+"""R2 positive fixture: every host-sync shape inside a registered
+kernel. Never imported."""
+
+import numpy as np
+
+from titan_tpu.utils.jitcache import jit_once
+
+
+def bad_kernel():
+    def build():
+        import jax
+
+        @jax.jit
+        def kern(x, y):
+            if x > 0:                    # Python `if` on a traced value
+                y = y + 1
+            n = int(x)                   # host coercion of a traced value
+            h = np.asarray(y)            # numpy materialization
+            g = jax.device_get(y)        # explicit device->host pull
+            s = y.sum().item()           # blocking scalar readback
+            return n + h + g + s
+
+        return kern
+
+    return jit_once("fixture_host_sync", build)
+
+
+def bad_mesh_kernel(mesh):
+    from titan_tpu.parallel.mesh import mesh_jit
+
+    def build(m):
+        def body(x, width):
+            while x.any():               # Python `while` on traced
+                x = x - 1
+            return float(x)              # coercion again
+
+        return body
+
+    return mesh_jit("fixture_mesh_sync", mesh, build, out_specs=None)

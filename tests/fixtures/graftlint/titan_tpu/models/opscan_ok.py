@@ -1,0 +1,26 @@
+"""R1 negative fixture: the legal neighbors of every banned shape."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from titan_tpu.utils.jitcache import jit_once
+
+
+def fine(mask, x, y, cap):
+    sel = jnp.where(mask, x, y)          # 3-arg select
+    host = np.nonzero(mask)              # host numpy, function form
+    flat = np.flatnonzero(mask)          # ditto
+    return sel, host, flat, cap
+
+
+def masked_scatter():
+    def build():
+        import jax
+
+        @jax.jit
+        def kern(x, m):
+            return x.at[m > 0].set(0)    # fixed-shape masked scatter
+
+        return kern
+
+    return jit_once("fixture_masked_scatter", build)

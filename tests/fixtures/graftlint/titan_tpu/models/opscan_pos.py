@@ -1,0 +1,29 @@
+"""R1 positive fixture: every banned op-scan shape. Never imported."""
+
+import jax.numpy as jnp
+
+from titan_tpu.utils.jitcache import jit_once
+
+
+def hard_banned(mask, n):
+    a = jnp.nonzero(mask)[0]                         # unbounded op-scan
+    b = jnp.nonzero(mask, size=8, fill_value=n)[0]   # bounded: still banned
+    c = jnp.flatnonzero(mask)                        # unbounded
+    d = jnp.unique(a)                                # data-dependent shape
+    e = jnp.where(mask)[0]                           # nonzero in disguise
+    f = jnp.where(mask, size=8)[0]                   # sized disguise: same
+    g = mask.nonzero()[0]                            # method spelling: same
+    return a, b, c, d, e, f, g
+
+
+def masked_gather():
+    def build():
+        import jax
+
+        @jax.jit
+        def kern(x, m):
+            return x[m > 0]         # bool-mask gather inside a kernel
+
+        return kern
+
+    return jit_once("fixture_masked_gather", build)

@@ -1,0 +1,9 @@
+"""R5 negative fixture: no seam declared — the module may read the
+wall clock freely (the rule enforces consistency, not seams)."""
+
+import time
+
+
+class Seamless:
+    def stamp(self):
+        return time.time()

@@ -1,0 +1,12 @@
+"""R5 negative fixture: a seamed module that routes every read through
+the seam."""
+
+import time
+
+
+class Seamed:
+    def __init__(self, clock=None):
+        self.clock = clock or time.time
+
+    def stamp(self):
+        return self.clock()

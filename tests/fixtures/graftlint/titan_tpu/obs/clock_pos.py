@@ -1,0 +1,14 @@
+"""R5 positive fixture: a declared clock seam with bare wall-clock
+reads beside it."""
+
+import time
+
+
+class Burny:
+    def __init__(self, clock=None):
+        self.clock = clock or time.time   # the seam default: a REFERENCE
+
+    def record(self):
+        now = time.time()                 # bare read despite the seam
+        mono = time.monotonic()           # same
+        return now, mono

@@ -1,0 +1,27 @@
+"""R3 negative fixture: cv waits, clock reads, state mutation under
+the lock; blocking work OUTSIDE it (the post-PR-10 `_requeue`)."""
+
+import json
+import time
+
+
+class Disciplined:
+    def poll(self, path):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait(0.25)      # waiting is the cv's job
+            t0 = time.time()             # clock READ is not blocking
+            items, self._queue = self._queue, []
+            self._cv.notify_all()
+        payload = json.dumps(items)      # serialize outside the lock
+        with open(path, "w") as fh:      # I/O outside the lock
+            fh.write(payload)
+        return t0
+
+    def schedule(self, cb):
+        with self._lock:
+            def later():                 # nested def doesn't RUN here
+                time.sleep(1.0)
+                cb()
+
+            self._cb = later

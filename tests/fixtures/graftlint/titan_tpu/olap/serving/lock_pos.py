@@ -1,0 +1,29 @@
+"""R3 positive fixture: the PR-10 `_requeue` stall shape — blocking
+work lexically under the scheduler cv / ledger lock. Never imported."""
+
+import json
+import os
+import subprocess
+import time
+import urllib.request
+
+
+class StallProne:
+    def _requeue(self, path, payload):
+        with self._cv:
+            with open(path, "w") as fh:            # file I/O under cv
+                json.dump(payload, fh)             # ... twice
+            os.replace(path, path + ".done")       # rename under cv
+            time.sleep(0.1)                        # sleep under cv
+            urllib.request.urlopen("http://x/")    # network under cv
+            subprocess.run(["sync"])               # subprocess under cv
+
+    def _dispatch(self, batch):
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            out = jnp.zeros((8,))                  # device dispatch
+            dev = jax.device_put(batch)            # upload under lock
+            out.block_until_ready()                # device sync
+            return out, dev

@@ -1,0 +1,13 @@
+"""R4 negative fixture: documented literals pass; variables and
+templated f-strings are test_docs_metrics's job, not the linter's."""
+
+FAMILY = "serving.fixture.dynamic"
+
+
+class Ok:
+    def __init__(self, metrics, kind):
+        metrics.timer("serving.fixture.documented")          # has a row
+        metrics.gauge("serving.fixture.documented_gauge",    # has a row
+                      lambda: 1.0)
+        metrics.counter(FAMILY)                              # variable
+        metrics.counter(f"serving.fixture.{kind}")           # templated

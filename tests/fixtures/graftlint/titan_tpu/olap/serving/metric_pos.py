@@ -1,0 +1,9 @@
+"""R4 positive fixture: literal metric names outside the pinned
+families, and a family-valid name with no monitoring.md row."""
+
+
+class Metered:
+    def __init__(self, metrics):
+        metrics.counter("bogus.name").inc()              # 2 components
+        metrics.histogram("unpinned.family.name")        # unknown family
+        metrics.timer("serving.fixture.undocumented")    # no doc row

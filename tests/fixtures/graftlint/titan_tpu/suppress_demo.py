@@ -1,0 +1,23 @@
+"""Suppression-semantics fixture: trailing allow, standalone allow by
+alias, and a reasonless allow that must stay INERT."""
+
+import jax.numpy as jnp
+
+
+def suppressed_trailing(mask):
+    return jnp.nonzero(mask)[0]  # graftlint: allow[opscan] reason=fixture demonstrating trailing-line suppression
+
+
+def suppressed_standalone(mask):
+    # graftlint: allow[R1] reason=fixture demonstrating next-line suppression by alias
+    return jnp.flatnonzero(mask)
+
+
+def bare_allow_is_inert(mask):
+    return jnp.unique(mask)  # graftlint: allow[opscan]
+
+
+# a directive QUOTED in a string is text, not a suppression — if it
+# were honored, the allow-file form below would silence this whole
+# file (including the deliberately-unsuppressed finding above)
+QUOTED = "# graftlint: allow-file[opscan] reason=quoted in a string"

@@ -5,6 +5,8 @@ pull-mode supersteps — dist' = min(dist, min over in-edges of dist[src]+1) —
 terminating when no distance changed (psum-agreed across chips).
 """
 
+# graftlint: allow-file[opscan] reason=plain reference model, not a round-loop hot path (exempt from the ops.compaction contract since ISSUE r6)
+
 from __future__ import annotations
 
 import jax.numpy as jnp

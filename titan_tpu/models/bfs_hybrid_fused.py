@@ -44,6 +44,8 @@ default for interactive use; the bench selects the fused path via
 SYMMETRIC GRAPHS ONLY (same contract as bfs_hybrid).
 """
 
+# graftlint: allow-file[opscan] reason=single-dispatch fused experiment, not a round-loop hot path — its in-branch nonzero compactions are the measured alternative ops.compaction is judged against (exempt since ISSUE r6)
+
 from __future__ import annotations
 
 import functools

@@ -1,0 +1,50 @@
+"""Text and JSON reporters."""
+
+from __future__ import annotations
+
+import json
+
+from tools.graftlint.engine import Result
+
+JSON_FORMAT = "graftlint-v1"
+
+
+def render_text(result: Result, *, show_suppressed: bool = False) -> str:
+    lines = []
+    for f in result.findings:
+        if f.suppressed is not None and not show_suppressed:
+            continue
+        tag = f" (suppressed:{f.suppressed} — {f.reason})" \
+            if f.suppressed else ""
+        lines.append(f"{f.path}:{f.line}:{f.col}: [{f.rule}] "
+                     f"{f.message}{tag}")
+        if f.snippet:
+            lines.append(f"    {f.snippet}")
+    for path, line in result.bare_allows:
+        lines.append(f"{path}:{line}: [graftlint] allow comment has no "
+                     "reason= — it is INERT (every suppression must "
+                     "say why)")
+    n = len(result.unsuppressed)
+    supp = len(result.findings) - n
+    lines.append(
+        f"graftlint: {len(result.files)} files, {n} finding(s)"
+        + (f" ({supp} suppressed)" if supp else "")
+        + f", {result.wall_s:.2f}s")
+    return "\n".join(lines)
+
+
+def render_json(result: Result, root: str) -> str:
+    return json.dumps({
+        "format": JSON_FORMAT,
+        "root": root,
+        "summary": {
+            "files": len(result.files),
+            "findings": len(result.findings),
+            "unsuppressed": len(result.unsuppressed),
+            "suppressed": len(result.findings)
+            - len(result.unsuppressed),
+            "bare_allows": len(result.bare_allows),
+            "wall_s": round(result.wall_s, 4),
+        },
+        "findings": [f.to_dict() for f in result.findings],
+    }, indent=1)

@@ -1,0 +1,23 @@
+"""Rule registry. Adding a rule = write the module, list it here, add
+its config entry (scope + options) to config.DEFAULT_CONFIG, and give
+it a fixture pair under tests/fixtures/graftlint/."""
+
+from tools.graftlint.rules.clockseam import ClockSeamRule
+from tools.graftlint.rules.hostsync import HostSyncRule
+from tools.graftlint.rules.lockdiscipline import LockDisciplineRule
+from tools.graftlint.rules.metricnames import MetricNameRule
+from tools.graftlint.rules.opscan import OpScanRule
+
+
+def default_rules() -> list:
+    return [OpScanRule, HostSyncRule, LockDisciplineRule,
+            MetricNameRule, ClockSeamRule]
+
+
+def rule_ids() -> dict:
+    """{id-or-alias: id} for CLI --rules / suppression validation."""
+    out = {}
+    for cls in default_rules():
+        out[cls.id] = cls.id
+        out[cls.alias] = cls.id
+    return out

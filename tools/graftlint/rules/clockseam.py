@@ -1,0 +1,59 @@
+"""R5 — clock injection discipline.
+
+Historical bug shape: obs/slo and olap/serving/autotune are tested
+against fake clocks (burn windows, cooldown hysteresis, decision
+journals); one bare ``time.time()`` on a code path those tests cover
+reintroduces wall-clock flakiness that only shows up under load. The
+convention: a module that DECLARES an injectable clock seam (any
+function parameter named ``clock``) must route every read through it.
+
+The seam default itself (``clock or time.time``, ``clock=time.time``)
+is a function REFERENCE, not a call, so it never trips the rule.
+Modules with no seam (e.g. obs/devprof) are out of scope — the rule
+enforces consistency where the seam exists, it doesn't mandate seams.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.graftlint.engine import Finding, Rule
+
+
+def _declares_seam(ms) -> bool:
+    for node in ast.walk(ms.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+                if p.arg == "clock":
+                    return True
+    return False
+
+
+class ClockSeamRule(Rule):
+    id = "clock-seam"
+    alias = "R5"
+    description = ("bare time.time()/time.monotonic() in modules that "
+                   "declare an injectable clock seam")
+
+    def check(self, ms, ctx) -> Iterator[Finding]:
+        if not _declares_seam(ms):
+            return
+        for node in ast.walk(ms.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = ms.canonical(node.func) or ""
+            bare = canon in ("time.time", "time.monotonic") or (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ms.clockfn_names)
+            if bare:
+                yield Finding(
+                    rule="", path="", line=node.lineno,
+                    col=node.col_offset,
+                    message=f"bare {canon or node.func.id}() in a "
+                            "module that declares an injectable clock "
+                            "seam — route it through the seam "
+                            "(self.clock()) so fake-clock tests stay "
+                            "honest")

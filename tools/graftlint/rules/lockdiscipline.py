@@ -1,0 +1,90 @@
+"""R3 — no blocking work under the serving/live locks.
+
+Historical bug (PR-10 review hardening): ``_requeue``'s cancel-race
+finalize wrote a flight-recorder postmortem bundle INSIDE ``with
+self._cv:`` — a slow dump directory stalled submit/get/cancel for
+every caller. The fix moved the write outside the cv; this rule pins
+the shape: file I/O, subprocess spawns, HTTP, ``time.sleep`` and
+device dispatch are banned lexically inside ``with self._cv:`` /
+``with self._lock:`` blocks in the serving and live planes.
+
+``cv.wait`` / ``cv.notify`` are of course fine (they're the point of
+holding the cv), as are plain state mutation and clock READS. Nested
+function bodies defined under a lock are skipped — they don't run
+while the lock is held.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from tools.graftlint.engine import Finding, Rule
+from tools.graftlint.jitgraph import walk_no_nested_fns
+
+_LOCK_ATTRS = ("_cv", "_lock")
+
+
+def _lock_name(expr) -> Optional[str]:
+    """`self._cv` / `anything._lock` / `x._foo_lock` -> display name."""
+    if isinstance(expr, ast.Attribute) and (
+            expr.attr in _LOCK_ATTRS
+            or expr.attr.endswith("_lock") or expr.attr.endswith("_cv")):
+        return expr.attr
+    return None
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    alias = "R3"
+    description = ("blocking I/O / sleep / device dispatch inside "
+                   "`with self._cv:` / `with self._lock:` blocks")
+
+    def check(self, ms, ctx) -> Iterator[Finding]:
+        for node in ast.walk(ms.tree):
+            if not isinstance(node, ast.With):
+                continue
+            lock = next((_lock_name(item.context_expr)
+                         for item in node.items
+                         if _lock_name(item.context_expr)), None)
+            if lock is None:
+                continue
+            for inner in walk_no_nested_fns(node.body):
+                if isinstance(inner, ast.Call):
+                    why = self._blocking(ms, inner)
+                    if why:
+                        yield Finding(
+                            rule="", path="", line=inner.lineno,
+                            col=inner.col_offset,
+                            message=f"{why} while holding {lock} — "
+                                    "move it outside the critical "
+                                    "section (the PR-10 _requeue "
+                                    "stall shape)")
+
+    def _blocking(self, ms, call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id == "open":
+                return "file I/O (open)"
+            if func.id in ms.sleep_names:
+                return "time.sleep"
+            return None
+        canon = ms.canonical(func) or ""
+        if canon == "time.sleep":
+            return "time.sleep"
+        if canon.startswith("subprocess."):
+            return f"subprocess spawn ({canon})"
+        if canon.startswith(("urllib.", "requests.", "http.",
+                             "socket.")):
+            return f"blocking network call ({canon})"
+        if canon in ("os.replace", "os.rename", "os.fsync",
+                     "json.dump", "pickle.dump") \
+                or canon.startswith("shutil."):
+            return f"file I/O ({canon})"
+        if canon in ("jax.device_put", "jax.device_get") \
+                or canon.startswith(("jnp.", "jax.numpy.")):
+            return f"device dispatch ({canon})"
+        if isinstance(func, ast.Attribute) \
+                and func.attr == "block_until_ready":
+            return "device sync (.block_until_ready)"
+        return None

@@ -1,0 +1,77 @@
+"""R4 — metric-name discipline.
+
+Historical bug: metric names drifted from docs/monitoring.md until
+tests/test_docs_metrics.py started pinning the family list by hand.
+This rule checks at the CREATION site: every string LITERAL passed to
+``metrics.counter/timer/histogram/gauge`` must
+
+* parse as ``<family>.<component>.<leaf...>`` with the family in the
+  pinned set (the same families test_docs_metrics._FAMILIES guards —
+  keep the two lists in sync), and
+* have a ``| `name` | ... |`` row in docs/monitoring.md.
+
+f-strings with placeholders are templated names — those are expanded
+and guarded by test_docs_metrics's registered expansions, so they're
+skipped here. Names passed through variables/constants are invisible
+to a literal scan by design; the doc-drift test still catches them.
+When the linted root has no docs/monitoring.md (fixture trees), only
+the family check runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from tools.graftlint.engine import Finding, Rule
+
+_CREATORS = {"counter", "timer", "histogram", "gauge"}
+
+
+def _literal_name(arg) -> Optional[str]:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        if all(isinstance(v, ast.Constant) for v in arg.values):
+            return "".join(v.value for v in arg.values)
+    return None
+
+
+class MetricNameRule(Rule):
+    id = "metric-name"
+    alias = "R4"
+    description = ("literal metric names must be <family>.<x>.<y> in "
+                   "the pinned families with a docs/monitoring.md row")
+
+    def check(self, ms, ctx) -> Iterator[Finding]:
+        families = self.options.get("families", [])
+        pattern = re.compile(
+            r"^(?:" + "|".join(map(re.escape, families))
+            + r")\.[a-z0-9_]+\.[a-z0-9_.]+$")
+        doc_rel = self.options.get("doc", "docs/monitoring.md")
+        doc_names = ctx.doc_metric_names(doc_rel)
+        for node in ast.walk(ms.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CREATORS and node.args):
+                continue
+            name = _literal_name(node.args[0])
+            if name is None:
+                continue        # variable or templated — not ours
+            if not pattern.match(name):
+                yield Finding(
+                    rule="", path="", line=node.lineno,
+                    col=node.col_offset,
+                    message=f"metric name {name!r} is outside the "
+                            f"pinned families ({'|'.join(families)}, "
+                            ">= 3 dot components) — rename it or "
+                            "extend tests/test_docs_metrics._FAMILIES "
+                            "and this rule's config together")
+            elif doc_names is not None and name not in doc_names:
+                yield Finding(
+                    rule="", path="", line=node.lineno,
+                    col=node.col_offset,
+                    message=f"metric name {name!r} has no "
+                            f"docs/monitoring.md table row — add one "
+                            "(the doc-drift guard will hold it)")
