@@ -71,6 +71,8 @@ _EST = {
     "serving":   (90,      0.1),   # small-graph batched BFS + retry
     "tenancy":   (60,      0.1),   # shares serving's kernel shapes
     "interactive": (90,    0.1),   # hops-mode fuse sweep + batched PPR
+    "bfs_pallas": (150,    1.2),   # both-mode compiles + warm reps
+    "segment_pallas": (60, 0.1),   # synthetic [E] array, two kernels
 }
 # nominal fast-day H2D rate (GB/s): bfs26's 9GB uploaded in 16.35s
 # (BENCH_r05); the headline stage's measured upload re-prices this
@@ -1195,6 +1197,105 @@ def gods_2hop(rep: Report) -> None:
     rep.emit()
 
 
+def bfs_pallas_stage(rep: Report, scale: int) -> None:
+    """ISSUE 16 evidence stage: the fused Pallas bottom-up frontier
+    kernel (``TITAN_TPU_FRONTIER_KERNEL=pallas``, ops/pallas_frontier)
+    vs the XLA bu chain on the warm-scale graph — warm best-of-3 per
+    mode from one source, results asserted bit-equal. Chip-only:
+    interpreter mode times an XLA emulation of the kernel, not the
+    chip (CPU parity is tier-1's job — tests/test_pallas_frontier.py),
+    so on CPU this stage is a recorded skip, never a fake number."""
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+
+    hg, g, _, _ = _load_device_graph(scale)
+    deg = np.asarray(hg["deg"])
+    source = int(np.flatnonzero(deg > 0)[0])
+    saved = os.environ.get("TITAN_TPU_FRONTIER_KERNEL")
+
+    def timed(mode):
+        os.environ["TITAN_TPU_FRONTIER_KERNEL"] = mode
+        d, lv = frontier_bfs_hybrid(g, source, return_device=True)
+        _ = int(np.asarray(d[0]))     # warm: compiles + first run
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.time()
+            d, lv = frontier_bfs_hybrid(g, source, return_device=True)
+            _ = int(np.asarray(d[0]))  # force completion (tunnel D2H)
+            best = min(best, time.time() - t0)
+        return best, np.asarray(d), lv
+
+    try:
+        t_x, d_x, lv_x = timed("xla")
+        t_p, d_p, lv_p = timed("pallas")
+    finally:
+        if saved is None:
+            os.environ.pop("TITAN_TPU_FRONTIER_KERNEL", None)
+        else:
+            os.environ["TITAN_TPU_FRONTIER_KERNEL"] = saved
+    if lv_x != lv_p or not np.array_equal(d_x, d_p):
+        raise AssertionError(
+            f"pallas bu result != xla result (levels {lv_p} vs {lv_x})")
+    rep.detail["bfs_pallas"] = {
+        "scale": scale, "source": source, "levels": lv_p,
+        "xla_seconds": round(t_x, 4),
+        "pallas_seconds": round(t_p, 4),
+        "pallas_bu_speedup_x": round(t_x / max(t_p, 1e-9), 3),
+        "results_bit_equal": True,
+    }
+    rep.emit()
+
+
+def segment_pallas_stage(rep: Report) -> None:
+    """ISSUE 16 satellite: the one-pass Pallas segmented combine
+    (``TITAN_TPU_SEGMENT_KERNEL=pallas``, ops/pallas_segment) vs the
+    XLA Hillis-Steele scan on a synthetic dst-sorted edge axis — the
+    SpMV primitive's kernel verdict as a first-class evidence line.
+    Chip-only for the same reason as bfs_pallas (interpreter mode is
+    an emulation; CPU parity lives in tests/test_pallas_segment.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from titan_tpu.ops.pallas_segment import pallas_sorted_segment_combine
+    from titan_tpu.ops.segment import (segment_metadata,
+                                       sorted_segment_combine)
+
+    e, n = 1 << 24, 1 << 20
+    rng = np.random.default_rng(5)
+    seg_ids = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    indptr = np.concatenate(
+        [[0], np.cumsum(np.bincount(seg_ids, minlength=n))])
+    last_idx, seg_has = segment_metadata(indptr)
+    vals = jnp.asarray(rng.random(e, dtype=np.float32))
+    ids_d = jnp.asarray(seg_ids)
+    li, sh = jnp.asarray(last_idx), jnp.asarray(seg_has)
+    scan_jit = jax.jit(sorted_segment_combine,
+                       static_argnames=("combine",))
+
+    def timed(fn):
+        out = fn()
+        _ = float(np.asarray(out[0]))     # warm + force D2H
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.time()
+            out = fn()
+            _ = float(np.asarray(out[0]))
+            best = min(best, time.time() - t0)
+        return best, out
+
+    t_x, o_x = timed(lambda: scan_jit(vals, ids_d, li, sh, combine="sum"))
+    t_p, o_p = timed(lambda: pallas_sorted_segment_combine(
+        vals, ids_d, li, sh, "sum"))
+    if not np.allclose(np.asarray(o_x), np.asarray(o_p), rtol=1e-5):
+        raise AssertionError("pallas segment combine != xla scan")
+    rep.detail["segment_pallas"] = {
+        "edges": e, "segments": n, "combine": "sum",
+        "xla_scan_seconds": round(t_x, 4),
+        "pallas_seconds": round(t_p, 4),
+        "segment_pallas_speedup_x": round(t_x / max(t_p, 1e-9), 3),
+    }
+    rep.emit()
+
+
 class Evidence:
     """``--evidence <path>`` (ISSUE 10, ROADMAP #5): wrap every stage
     in the device-cost profiler and write ONE machine-readable bundle
@@ -1269,6 +1370,8 @@ class Evidence:
         serving = det.get("serving")
         interactive = det.get("interactive")
         tenancy = det.get("tenancy")
+        bfs_pal = det.get("bfs_pallas")
+        seg_pal = det.get("segment_pallas")
         return {
             # ISSUE 15: the invariants held for this tree (graftlint)
             "lint_clean": self._lint_clean(),
@@ -1321,6 +1424,17 @@ class Evidence:
                           "ppr_batched_users_per_s",
                           "ppr_speedup_x")})
                 if interactive is not None else absent("interactive")),
+            # ISSUE 16: the Pallas kernels' on-chip verdicts — a value
+            # on the TPU backend, a recorded skip on CPU (interpreter-
+            # mode parity is tier-1's job; wall-clock is the chip's)
+            "pallas_bu_speedup": (
+                present({k: bfs_pal[k] for k in
+                         ("xla_seconds", "pallas_seconds",
+                          "pallas_bu_speedup_x", "results_bit_equal")})
+                if bfs_pal is not None else absent("bfs_pallas")),
+            "segment_kernel_pallas_speedup": (
+                present(seg_pal) if seg_pal is not None
+                else absent("segment_pallas")),
         }
 
     def write(self) -> None:
@@ -1435,6 +1549,11 @@ def main() -> None:
         # fuse-economics lines ROADMAP #3 asked for
         ("interactive", lambda: interactive_stage(
             rep, 14 if on_accel else min(headline_scale, 12))),
+        # Pallas kernel verdicts (ISSUE 16): the fused bottom-up
+        # frontier kernel and the one-pass segment scan vs their XLA
+        # paths — chip-only (interpreter mode times an XLA emulation)
+        ("bfs_pallas", lambda: bfs_pallas_stage(rep, warm_scale)),
+        ("segment_pallas", lambda: segment_pallas_stage(rep)),
         # the sharded-overhead stage also times the plain hybrid at the
         # warm scale, so it outranks the standalone warm stage when the
         # budget is tight
@@ -1445,10 +1564,22 @@ def main() -> None:
     # silent removal — the evidence checklist (ROADMAP #5) must show a
     # value or a reason for every line
     if not on_accel:
-        stages = [s for s in stages if s[0] != "bfs_heavy"]
-        rep.detail["skipped"].append(
-            {"stage": "bfs_heavy",
-             "why": "no accelerator: Twitter-parity graph needs a chip"})
+        cpu_skips = {
+            "bfs_heavy":
+                "no accelerator: Twitter-parity graph needs a chip",
+            "bfs_pallas":
+                "no accelerator: interpreter mode times an XLA "
+                "emulation of the kernel, not the chip; interpreter-"
+                "mode bit-equality is pinned in tier-1 "
+                "(tests/test_pallas_frontier.py)",
+            "segment_pallas":
+                "no accelerator: the pallas segment combine engages "
+                "only on the TPU backend; interpreter-mode parity is "
+                "pinned in tier-1 (tests/test_pallas_segment.py)",
+        }
+        stages = [s for s in stages if s[0] not in cpu_skips]
+        for st, why in cpu_skips.items():
+            rep.detail["skipped"].append({"stage": st, "why": why})
     if warm_scale == headline_scale:      # CPU/CI path: one BFS scale
         # the plain warm BFS duplicates the headline at this scale and
         # drops; the SHARDED overhead stage stays — it reuses the

@@ -1,0 +1,39 @@
+"""R1/R2 positive fixture: traced-ref abuse inside ``pl.pallas_call``
+kernels, through both registration spellings — an inline
+``functools.partial`` and a local ``kern = ...`` name. Never
+imported."""
+
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _bad_kernel(x_ref, o_ref, *, block):
+    if x_ref[0] > 0:                  # Python `if` on a traced ref
+        o_ref[0] = 1
+    n = int(x_ref[0])                 # host coercion of a traced ref
+    s = x_ref[...].sum().item()       # blocking scalar readback
+    v = x_ref[...]
+    o_ref[...] = v[v > 0]             # bool-mask gather inside a kernel
+    del n, s, block
+
+
+def run_inline(x):
+    return pl.pallas_call(
+        functools.partial(_bad_kernel, block=128),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def _bad_local(y_ref, o_ref, *, width):
+    while y_ref[0] > 0:               # Python `while` on a traced ref
+        o_ref[0] = width
+
+
+def run_local(y):
+    kern = functools.partial(_bad_local, width=8)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct(y.shape, y.dtype),
+    )(y)

@@ -134,6 +134,43 @@ def test_zero_recompiles_on_warm_smoke_shapes(snap):
         labels={"kernel": "batched_plan"}) > 0
 
 
+def test_pallas_path_zero_recompiles_and_kernel_labels(snap,
+                                                       monkeypatch):
+    """TITAN_TPU_FRONTIER_KERNEL=pallas (ISSUE 16): the Pallas bottom-up
+    wrappers register through jit_once like every XLA kernel, so they
+    carry the same warm-shape contract — one warm pass, then zero new
+    compile buckets — and show up under the device.exec.* {kernel}
+    labels the decision plane reads."""
+    import titan_tpu.models.bfs_hybrid as H
+
+    monkeypatch.setenv("TITAN_TPU_FRONTIER_KERNEL", "pallas")
+    # route the plain driver through the bottom-up chain at smoke scale
+    # (tests/test_pallas_frontier.py idiom)
+    monkeypatch.setattr(H, "SPLIT_LANE_MIN", 2)
+    monkeypatch.setattr(H, "END_C_CAP", 0)
+    monkeypatch.setattr(H, "END_P_CAP", 0)
+    monkeypatch.setattr(H, "HEAD_F_CAP", 1)
+    rng = np.random.default_rng(7)
+    nz = np.flatnonzero(snap.out_degree > 0)
+    s8 = [int(s) for s in rng.choice(nz, size=8, replace=True)]
+    workloads = [lambda: frontier_bfs_hybrid(snap, int(nz[0])),
+                 lambda: frontier_bfs_batched(snap, s8)]
+    for fn in workloads:
+        fn()                                   # warm pass (may compile)
+    mm = MetricManager()
+    with devprof.DeviceCostProfiler(metrics=mm) as prof:
+        for fn in workloads:
+            fn()
+        assert prof.compiles() == 0, (
+            f"pallas path recompiled warm: {prof.compile_log()[-3:]}")
+    kernels = prof.kernel_stats()
+    assert "pallas_bu_start" in kernels, sorted(kernels)
+    assert "pallas_batched_bu" in kernels, sorted(kernels)
+    for kern in ("pallas_bu_start", "pallas_batched_bu"):
+        assert mm.counter_value("device.exec.calls",
+                                labels={"kernel": kern}) > 0
+
+
 def test_compile_miss_counts_once_per_new_bucket(snap):
     """A genuinely new static shape bucket counts exactly one compile,
     and repeating it counts a cache hit — the hit/miss split the guard

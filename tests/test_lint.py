@@ -98,6 +98,32 @@ def test_r2_hostsync_negative_statics_and_shape_metadata(fixture_result):
     assert _in(fixture_result, "titan_tpu/models/hostsync_ok.py") == []
 
 
+def test_r1_r2_see_inside_pallas_kernels(fixture_result):
+    """ISSUE 16: ``pl.pallas_call`` is the third registration seam —
+    the kernel resolves through both spellings (inline
+    ``functools.partial`` and a local ``kern = partial(...)`` name) and
+    traced-ref abuse inside the kernel body is flagged, not invisibly
+    exempt."""
+    got = _in(fixture_result, "titan_tpu/models/pallas_pos.py")
+    assert {f.rule for f in got} == {"opscan", "host-sync"}
+    assert len(got) == 5
+    msgs = _msgs(got)
+    assert "Python `if` on a traced value" in msgs
+    assert "Python `while` on a traced value" in msgs
+    assert "int() coerces a traced value" in msgs
+    assert ".item()" in msgs
+    assert "boolean-mask indexing inside a jitted kernel" in msgs
+    # pallas kernels have no literal key: messages cite the call line
+    assert "registered at line" in msgs
+
+
+def test_pallas_kernel_static_config_params_stay_legal(fixture_result):
+    """Keyword-only params bound through ``functools.partial`` are
+    compile-time constants: ``while d < block`` ladders and
+    ``if masked`` config branches must NOT read as host syncs."""
+    assert _in(fixture_result, "titan_tpu/models/pallas_ok.py") == []
+
+
 def test_r3_lock_discipline_fires(fixture_result):
     got = _in(fixture_result,
               "titan_tpu/olap/serving/lock_pos.py")

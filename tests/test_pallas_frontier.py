@@ -1,0 +1,238 @@
+"""Interpreter-mode bit-equality of the Pallas bottom-up frontier
+kernel against the XLA chain (ISSUE 16).
+
+``TITAN_TPU_FRONTIER_KERNEL=pallas`` routes the bottom-up candidate
+fetch+test+compact through ops/pallas_frontier.frontier_round; off-TPU
+the kernel runs in Pallas interpreter mode, so these tests exercise the
+EXACT kernel program on CPU and pin bit-equality to the XLA path across
+{plain, batched K=8, sharded 8-dev mesh} x {no overlay, tombstone
+overlay} x {no masks, level_masks} x seeds. A direct oracle test covers
+the kernel contract itself (lane ladder, tombstone slots, stable
+survivor compaction, multi-block SMEM cursor carry).
+"""
+
+import numpy as np
+import pytest
+
+import titan_tpu.models.bfs_hybrid as H
+from titan_tpu.olap.tpu import snapshot as snap_mod
+from titan_tpu.ops.pallas_frontier import (frontier_kernel_mode,
+                                           frontier_round,
+                                           ladder_fetch_counts)
+
+N, M = 192, 900
+SEEDS = [0, 1, 2]
+
+
+def sym_snap(seed, n=N, m=M):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n, m)
+    dst = rng.integers(0, n, m)
+    return snap_mod.from_arrays(n, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+
+
+def force_bu(monkeypatch):
+    """Route the plain driver through the bottom-up chain at toy scale
+    (the head loop and the endgame would otherwise swallow it — same
+    idiom as tests/test_frontier_models.py)."""
+    monkeypatch.setattr(H, "SPLIT_LANE_MIN", 2)
+    monkeypatch.setattr(H, "END_C_CAP", 0)
+    monkeypatch.setattr(H, "END_P_CAP", 0)
+    monkeypatch.setattr(H, "HEAD_F_CAP", 1)
+
+
+def both_modes(monkeypatch, run):
+    monkeypatch.setenv("TITAN_TPU_FRONTIER_KERNEL", "xla")
+    ref = run()
+    monkeypatch.setenv("TITAN_TPU_FRONTIER_KERNEL", "pallas")
+    got = run()
+    return ref, got
+
+
+def assert_tuples_equal(ref, got):
+    for a, b in zip(ref, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mode_flag_validates(monkeypatch):
+    monkeypatch.setenv("TITAN_TPU_FRONTIER_KERNEL", "mosaic")
+    with pytest.raises(ValueError, match="TITAN_TPU_FRONTIER_KERNEL"):
+        frontier_kernel_mode()
+    monkeypatch.delenv("TITAN_TPU_FRONTIER_KERNEL")
+    assert frontier_kernel_mode() == "xla"
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_plain_bu_bit_equal(seed, monkeypatch):
+    force_bu(monkeypatch)
+    snap = sym_snap(seed)
+    src = int(np.flatnonzero(snap.out_degree > 0)[0])
+    ref, got = both_modes(
+        monkeypatch, lambda: H.frontier_bfs_hybrid(snap, src))
+    assert np.array_equal(ref[0], got[0])
+    assert ref[1] == got[1]
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_batched_bit_equal(seed, monkeypatch):
+    snap = sym_snap(seed)
+    rng = np.random.default_rng(seed)
+    sources = [int(x) for x in rng.choice(N, 8, replace=False)]
+    ref, got = both_modes(
+        monkeypatch, lambda: H.frontier_bfs_batched(snap, sources))
+    assert_tuples_equal(ref, got)
+
+
+def _overlay_view(snap, seed, src, dst):
+    from titan_tpu.olap.live.overlay import DeltaOverlay
+
+    rng = np.random.default_rng(seed + 100)
+    ov = DeltaOverlay(snap, min_cap=256)
+    a_s = rng.integers(0, N, 60).astype(np.int32)
+    a_d = rng.integers(0, N, 60).astype(np.int32)
+    ov.append_edges(np.concatenate([a_s, a_d]),
+                    np.concatenate([a_d, a_s]),
+                    np.zeros(120, np.int32))
+    for i in rng.choice(M, 40, replace=False):
+        ov.remove_edge(int(src[i]), int(dst[i]), None)
+        ov.remove_edge(int(dst[i]), int(src[i]), None)
+    return ov.view()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_batched_tombstone_overlay_bit_equal(seed, monkeypatch):
+    """The tombstone bitmap rides the kernel's tbits seam: flag-on must
+    match flag-off under a live overlay with adds AND removes."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, N, M).astype(np.int32)
+    dst = rng.integers(0, N, M).astype(np.int32)
+    snap = snap_mod.from_arrays(N, np.concatenate([src, dst]),
+                                np.concatenate([dst, src]))
+    view = _overlay_view(snap, seed, src, dst)
+    sources = [int(x) for x in rng.choice(N, 8, replace=False)]
+    ref, got = both_modes(
+        monkeypatch,
+        lambda: H.frontier_bfs_batched(snap, sources, overlay=view))
+    assert_tuples_equal(ref, got)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_batched_level_masks_bit_equal(seed, monkeypatch):
+    """Per-level label masks (hops mode) ride the same tbits seam."""
+    import jax.numpy as jnp
+
+    snap = sym_snap(seed)
+    g = H.build_chunked_csr(snap)
+    rng = np.random.default_rng(seed)
+    lm_bytes = rng.integers(0, 256, g["q_total"]).astype(np.uint8)
+    lm_bytes[-1] = 0                    # the all-pad sink column
+    lm = jnp.asarray(lm_bytes)
+    sources = [int(x) for x in rng.choice(N, 8, replace=False)]
+    ref, got = both_modes(
+        monkeypatch,
+        lambda: H.frontier_bfs_batched(
+            snap, sources, mode="hops", start_level=1, max_levels=4,
+            level_masks=[None, lm, lm]))
+    assert_tuples_equal(ref, got)
+
+
+@pytest.mark.parametrize(
+    "seed", [SEEDS[0]] + [pytest.param(s, marks=pytest.mark.slow)
+                          for s in SEEDS[1:]])
+def test_sharded_bit_equal_and_dispatch_budget(seed, monkeypatch):
+    """shx_bu_pallas on the 8-device CPU mesh: bit-equal to the plain
+    hybrid AND the per-level dispatch budget (<= 2 with the found_cap
+    retry) unchanged from the XLA path."""
+    import titan_tpu.models.bfs_hybrid_sharded as S
+    from titan_tpu.parallel.mesh import vertex_mesh
+
+    snap = sym_snap(seed, n=600, m=3000)
+    src = int(np.flatnonzero(snap.out_degree > 0)[0])
+    d_plain, lv_plain = H.frontier_bfs_hybrid(snap, src)
+    mesh = vertex_mesh(8)
+
+    def run():
+        out = S.frontier_bfs_hybrid_sharded(snap, src, mesh)
+        return out + ([p["dispatches"] for p in S.LAST_PROFILE],)
+
+    (d0, l0, disp0), (d1, l1, disp1) = both_modes(monkeypatch, run)
+    assert np.array_equal(np.asarray(d0), d_plain) and l0 == lv_plain
+    assert np.array_equal(np.asarray(d1), d_plain) and l1 == lv_plain
+    assert disp0 == disp1 and max(disp1) <= 2
+
+
+@pytest.mark.parametrize("lanes", [2, 8])
+@pytest.mark.parametrize("masked", [False, True])
+def test_frontier_round_matches_oracle(lanes, masked):
+    """Direct kernel contract vs a numpy oracle: found flags equal the
+    flat 8-lane masked bitmap test for every undecided (job, candidate)
+    pair; survivors compact in stable candidate order with the
+    scatter_compact fills; nsur is exact. block=16 forces the
+    multi-block SMEM-cursor path (C=70 -> 5 blocks with a padded
+    tail)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    K, C, Q = 3, 70, 51
+    q_pad = Q - 1
+    n_val = 160                          # parent ids in [0, n_val]
+    dstT = rng.integers(0, n_val + 1, (8, Q)).astype(np.int32)
+    cols = rng.integers(0, Q, C).astype(np.int32)
+    undec = rng.random((K, C)) < 0.7
+    has_more = rng.random(C) < 0.6
+    pay0 = rng.integers(0, n_val, C).astype(np.int32)
+    pay1 = rng.integers(0, 8, C).astype(np.int32)
+    fbits = rng.integers(0, 256, (K, (n_val + 9) // 8)).astype(np.uint8)
+    tbits = rng.integers(0, 256, Q).astype(np.uint8) if masked else None
+
+    found, p0, p1, nsur = frontier_round(
+        jnp.asarray(cols), jnp.asarray(undec), jnp.asarray(has_more),
+        jnp.asarray(pay0), jnp.asarray(pay1), jnp.asarray(fbits),
+        None if tbits is None else jnp.asarray(tbits),
+        jnp.asarray(dstT), lanes=lanes, fill0=-7, fill1=-9, block=16,
+        interpret=True)
+
+    par = dstT[:, cols]                              # (8, C)
+    hit = (fbits[:, par >> 3] >> (par & 7)[None]) & 1   # (K, 8, C)
+    if masked:
+        slot = cols[None, :] * 8 + np.arange(8)[:, None]
+        hit = hit & ~((tbits[slot >> 3] >> (slot & 7)) & 1)[None]
+    hit = hit.any(axis=1)                            # (K, C)
+    exp_found = undec & hit
+    assert np.array_equal(np.asarray(found), exp_found)
+
+    surv = (undec & ~hit).any(axis=0) & has_more
+    idx = np.flatnonzero(surv)
+    assert int(nsur) == idx.size
+    exp0 = np.full(C, -7, np.int32)
+    exp1 = np.full(C, -9, np.int32)
+    exp0[:idx.size] = pay0[idx]
+    exp1[:idx.size] = pay1[idx]
+    assert np.array_equal(np.asarray(p0), exp0)
+    assert np.array_equal(np.asarray(p1), exp1)
+
+
+def test_ladder_never_changes_found_set():
+    """The narrow-first ladder (lanes=2) and the flat 8-lane fetch
+    (lanes=8) produce identical kernel outputs — the fetched-byte
+    saving is free of result risk by construction."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    K, C, Q = 2, 40, 33
+    dstT = rng.integers(0, 120, (8, Q)).astype(np.int32)
+    cols = rng.integers(0, Q, C).astype(np.int32)
+    undec = rng.random((K, C)) < 0.8
+    has_more = rng.random(C) < 0.5
+    pay0 = np.arange(C, dtype=np.int32)
+    pay1 = np.arange(C, dtype=np.int32) * 2
+    fbits = rng.integers(0, 256, (K, 16)).astype(np.uint8)
+    args = (jnp.asarray(cols), jnp.asarray(undec),
+            jnp.asarray(has_more), jnp.asarray(pay0),
+            jnp.asarray(pay1), jnp.asarray(fbits), None,
+            jnp.asarray(dstT))
+    outs = [frontier_round(*args, lanes=w, fill0=0, fill1=0, block=16,
+                           interpret=True) for w in (2, 8)]
+    for a, b in zip(*outs):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

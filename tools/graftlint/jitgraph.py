@@ -2,10 +2,11 @@
 
 R1's boolean-mask check and R2's host-sync checks only make sense
 inside code that is actually TRACED. Name heuristics ("looks like a
-kernel") rot; the repo has exactly two registration seams every traced
-kernel flows through — ``utils/jitcache.jit_once(key, builder)`` and
-``parallel/mesh.mesh_jit(name, mesh, builder, ...)`` — so this module
-follows those call sites instead:
+kernel") rot; the repo has exactly three registration seams every
+traced kernel flows through — ``utils/jitcache.jit_once(key,
+builder)``, ``parallel/mesh.mesh_jit(name, mesh, builder, ...)`` and
+``pl.pallas_call(kernel, ...)`` — so this module follows those call
+sites instead:
 
     registration call -> builder (local def or lambda)
                       -> the callable the builder returns
@@ -14,6 +15,12 @@ follows those call sites instead:
                          static_argnames / static_argnums on the way
 
 The resolved function's non-static parameters are the traced values.
+Pallas kernels invert the convention: ``pallas_call`` passes only the
+refs, positionally, so the kernel's POSITIONAL parameters are the
+traced refs while keyword-only parameters (bound through
+``functools.partial`` at the call site) are compile-time constants —
+Python control flow on them is legal and expected
+(ops/pallas_segment.py's ``while d < block`` ladder).
 Resolution is best-effort and PURELY lexical: a builder whose return
 can't be followed (e.g. mesh.py's own generic ``builder(mesh)``
 trampoline) contributes nothing rather than guessing.
@@ -65,6 +72,9 @@ class _Resolver:
         # id(scope node) -> {name: FunctionDef} for defs bound
         # directly in that scope (module, function, or lambda)
         self.defs: dict = {}
+        # id(scope node) -> {name: value expr} for single-target
+        # assignments (follows `kern = functools.partial(...)` locals)
+        self.assigns: dict = {}
         self.reg_calls: list = []   # (Call, scope chain)
         self._index(ms.tree, (ms.tree,))
 
@@ -77,6 +87,11 @@ class _Resolver:
             elif isinstance(child, ast.Lambda):
                 self._index(child, chain + (child,))
             else:
+                if isinstance(child, ast.Assign) \
+                        and len(child.targets) == 1 \
+                        and isinstance(child.targets[0], ast.Name):
+                    self.assigns.setdefault(id(scope), {})[
+                        child.targets[0].id] = child.value
                 if isinstance(child, ast.Call):
                     kind = self._reg_kind(child.func)
                     if kind:
@@ -92,6 +107,8 @@ class _Resolver:
             return "jit_once"
         if last == "mesh_jit" or d in self.ms.meshjit_names:
             return "mesh_jit"
+        if last == "pallas_call":
+            return "pallas_call"
         return None
 
     # -- scope-chain name lookup ------------------------------------------
@@ -99,6 +116,13 @@ class _Resolver:
     def _find_def(self, name: str, chain):
         for scope in reversed(chain):
             got = self.defs.get(id(scope), {}).get(name)
+            if got is not None:
+                return got
+        return None
+
+    def _find_assign(self, name: str, chain):
+        for scope in reversed(chain):
+            got = self.assigns.get(id(scope), {}).get(name)
             if got is not None:
                 return got
         return None
@@ -154,12 +178,57 @@ class _Resolver:
                     return fn, statics
         return None, set()
 
+    # -- pallas_call kernels ----------------------------------------------
+
+    def _pallas_kernel(self, call: ast.Call, chain):
+        """(kernel fn node, statics) for ``pl.pallas_call(kern, ...)``:
+        arg0 as a def/lambda, a ``functools.partial(kernel, **consts)``
+        binding compile-time keywords, or a local name assigned one of
+        those."""
+        target = _arg(call, 0, "kernel")
+        statics: set = set()
+        for _hop in range(4):
+            if not isinstance(target, ast.Name):
+                break
+            fn = self._find_def(target.id, chain)
+            if fn is not None:
+                return fn, statics
+            target = self._find_assign(target.id, chain)
+        if isinstance(target, ast.Lambda):
+            return target, statics
+        if isinstance(target, ast.Call):
+            d = (self.ms.canonical(target.func) or "").rsplit(".", 1)[-1]
+            if d == "partial" and target.args:
+                statics |= {k.arg for k in target.keywords if k.arg}
+                inner = target.args[0]
+                if isinstance(inner, ast.Lambda):
+                    return inner, statics
+                if isinstance(inner, ast.Name):
+                    fn = self._find_def(inner.id, chain)
+                    if fn is not None:
+                        return fn, statics
+        return None, statics
+
     # -- entry -------------------------------------------------------------
 
     def resolve(self) -> list:
         out: list = []
         seen: set = set()
         for call, chain, kind in self.reg_calls:
+            if kind == "pallas_call":
+                fn, statics = self._pallas_kernel(call, chain)
+                if fn is None or id(fn) in seen:
+                    continue
+                seen.add(id(fn))
+                # only the positional refs are traced: keyword-only
+                # params never receive refs through pallas_call
+                out.append(JittedFn(
+                    node=fn,
+                    traced=frozenset(
+                        set(_positional_params(fn)) - statics),
+                    reg_line=call.lineno,
+                    key=None))
+                continue
             is_mesh = kind == "mesh_jit"
             builder = _arg(call, 2 if is_mesh else 1, "builder")
             if builder is None:
