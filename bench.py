@@ -73,6 +73,8 @@ _EST = {
     "interactive": (90,    0.1),   # hops-mode fuse sweep + batched PPR
     "bfs_pallas": (150,    1.2),   # both-mode compiles + warm reps
     "segment_pallas": (60, 0.1),   # synthetic [E] array, two kernels
+    "distributed_scan": (30, 0.0),  # host-only: 2 HTTP workers, tiny
+                                    # graph, no device work at all
 }
 # nominal fast-day H2D rate (GB/s): bfs26's 9GB uploaded in 16.35s
 # (BENCH_r05); the headline stage's measured upload re-prices this
@@ -1296,6 +1298,80 @@ def segment_pallas_stage(rep: Report) -> None:
     rep.emit()
 
 
+def distributed_scan_stage(rep: Report) -> None:
+    """ISSUE 18 (ROADMAP #2/#5): cross-process observability evidence.
+    A small scan fanned out to two HTTP scan workers over remote-cluster
+    storage, with trace propagation ON — records the ONE stitched trace
+    (worker split/execute/serialize spans spliced under the
+    coordinator's split spans by Tracer.ingest) as span counts + ingest
+    drop accounting. Host-only HTTP + dict stores: CPU-runnable."""
+    import titan_tpu
+    from titan_tpu.obs.tracing import Tracer
+    from titan_tpu.olap.distributed import ScanJobSpec
+    from titan_tpu.olap.jobs import VertexCountJob
+    from titan_tpu.olap.scan_worker import (RemoteScanRunner,
+                                            ScanWorkerServer)
+    from titan_tpu.storage.inmemory import InMemoryStoreManager
+    from titan_tpu.storage.remote import KCVSServer
+    from titan_tpu.utils.metrics import MetricManager
+
+    n = 64
+    storage = [KCVSServer(InMemoryStoreManager()).start()
+               for _ in range(2)]
+    workers = [ScanWorkerServer().start() for _ in range(2)]
+    try:
+        cfg = {"storage.backend": "remote-cluster",
+               "storage.hostname":
+                   [f"127.0.0.1:{s.port}" for s in storage],
+               "storage.cluster.replication-factor": 2}
+        g = titan_tpu.open(cfg)
+        tx = g.new_transaction()
+        for i in range(n):
+            tx.add_vertex("person", name=f"b{i}")
+        tx.commit()
+        g.close()
+
+        m = MetricManager()
+        tracer = Tracer()
+        t0 = time.time()
+        runner = RemoteScanRunner(
+            [f"127.0.0.1:{w.port}" for w in workers], cfg,
+            metrics=m, tracer=tracer, trace_id="bench-scan")
+        got = runner.run(ScanJobSpec(
+            "titan_tpu.olap.jobs:make_vertex_count_job"))
+        wall = time.time() - t0
+        if got.get(VertexCountJob.VERTICES) != n:
+            raise AssertionError(
+                f"distributed scan counted "
+                f"{got.get(VertexCountJob.VERTICES)} != {n}")
+
+        tree = tracer.tree("bench-scan")
+        if tree is None:
+            raise AssertionError("no stitched trace for bench-scan")
+        spans, instances, stack = 0, set(), list(tree["spans"])
+        while stack:
+            node = stack.pop()
+            spans += 1
+            attrs = node.get("attrs") or {}
+            if attrs.get("remote"):
+                instances.add(attrs["instance"])
+            stack.extend(node["children"])
+        rep.detail["distributed_scan"] = {
+            "workers": len(workers),
+            "coordinator_splits": len(tree["spans"]),
+            "stitched_spans": spans,
+            "remote_instances": len(instances),
+            "ingest_spans": int(m.counter_value("obs.ingest.spans")),
+            "ingest_dropped":
+                int(m.counter_value("obs.ingest.dropped")),
+            "scan_wall_s": round(wall, 3),
+        }
+    finally:
+        for node in workers + storage:
+            node.stop()
+    rep.emit()
+
+
 class Evidence:
     """``--evidence <path>`` (ISSUE 10, ROADMAP #5): wrap every stage
     in the device-cost profiler and write ONE machine-readable bundle
@@ -1435,6 +1511,13 @@ class Evidence:
             "segment_kernel_pallas_speedup": (
                 present(seg_pal) if seg_pal is not None
                 else absent("segment_pallas")),
+            # ISSUE 18 (ROADMAP #2): the cross-process trace — stitched
+            # span count across 2 worker processes + ingest drop
+            # accounting, or the stage's recorded skip reason
+            "distributed_scan_trace": (
+                present(det["distributed_scan"])
+                if det.get("distributed_scan") is not None
+                else absent("distributed_scan")),
         }
 
     def write(self) -> None:
@@ -1549,6 +1632,10 @@ def main() -> None:
         # fuse-economics lines ROADMAP #3 asked for
         ("interactive", lambda: interactive_stage(
             rep, 14 if on_accel else min(headline_scale, 12))),
+        # cross-process observability evidence (ISSUE 18): stitched
+        # distributed-scan trace + ingest accounting — host-only HTTP
+        # against dict stores, so it runs on CPU and chip days alike
+        ("distributed_scan", lambda: distributed_scan_stage(rep)),
         # Pallas kernel verdicts (ISSUE 16): the fused bottom-up
         # frontier kernel and the one-pass segment scan vs their XLA
         # paths — chip-only (interpreter mode times an XLA emulation)

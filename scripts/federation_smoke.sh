@@ -1,0 +1,244 @@
+#!/usr/bin/env bash
+# Cross-process observability smoke (ISSUE 18): two REAL scan-worker
+# subprocesses against in-process remote-cluster storage, one worker
+# SIGKILLed mid-scan. Asserts, end to end:
+#
+#   * the scan completes correctly despite the death (failover
+#     redispatch), and the coordinator's Tracer holds ONE stitched
+#     trace tree: worker split/execute/serialize spans (shipped back
+#     over the wire and skew-normalized by Tracer.ingest) parented
+#     under the coordinator's split spans — including the dead
+#     worker's partial spans sitting beside the redispatch span;
+#   * GET /metrics?federate=1 on the GraphServer re-exports BOTH
+#     workers' registries under instance labels while both are alive;
+#   * after the kill, repeated scrapes evict the dead peer
+#     (obs.federate.evicted) — its series vanish from the federated
+#     body while the survivor's remain — and GET /fleet reports it
+#     down with the failure count that evicted it.
+#
+# Usage: scripts/federation_smoke.sh   (CPU-safe; ~60s incl. worker
+# subprocess startups)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python - <<'EOF'
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import titan_tpu
+from titan_tpu.obs.federate import Federator
+from titan_tpu.obs.tracing import Tracer
+from titan_tpu.olap.distributed import ScanJobSpec
+from titan_tpu.olap.jobs import VertexCountJob
+from titan_tpu.olap.scan_worker import RemoteScanRunner
+from titan_tpu.server import GraphServer
+from titan_tpu.storage.inmemory import InMemoryStoreManager
+from titan_tpu.storage.remote import KCVSServer
+from titan_tpu.utils.httpnode import text_get
+from titan_tpu.utils.metrics import MetricManager
+
+N_PEOPLE, N_EDGES = 200, 100
+
+# a job slow enough that a worker is always mid-split when killed; the
+# workers import it via TITAN_TPU_SCAN_FACTORIES + PYTHONPATH
+SLOW_JOB = """\
+import time
+from titan_tpu.olap.jobs import VertexCountJob
+
+class SlowCountJob(VertexCountJob):
+    def process(self, key, entries_by_query, metrics):
+        time.sleep(0.02)
+        super().process(key, entries_by_query, metrics)
+
+def make_slow_count_job(graph):
+    return SlowCountJob(graph)
+"""
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def http_get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return resp.read().decode("utf-8")
+
+
+storage = [KCVSServer(InMemoryStoreManager()).start() for _ in range(2)]
+cfg = {"storage.backend": "remote-cluster",
+       "storage.hostname": [f"127.0.0.1:{s.port}" for s in storage],
+       "storage.cluster.replication-factor": 2}
+
+import numpy as np
+g = titan_tpu.open(cfg)
+tx = g.new_transaction()
+people = [tx.add_vertex("person", name=f"p{i}") for i in range(N_PEOPLE)]
+rng = np.random.default_rng(7)
+for _ in range(N_EDGES):
+    a, b = rng.integers(0, N_PEOPLE, 2)
+    people[int(a)].add_edge("knows", people[int(b)])
+tx.commit()
+
+tmp = tempfile.mkdtemp(prefix="fedsmoke-")
+with open(os.path.join(tmp, "smokejobs.py"), "w") as f:
+    f.write(SLOW_JOB)
+
+env = dict(os.environ,
+           JAX_PLATFORMS="cpu",
+           TITAN_TPU_SCAN_FACTORIES="smokejobs",
+           PYTHONPATH=tmp + os.pathsep + os.getcwd()
+           + os.pathsep + os.environ.get("PYTHONPATH", ""))
+ports = [free_port(), free_port()]
+procs = [subprocess.Popen(
+    [sys.executable, "-m", "titan_tpu.olap.scan_worker", str(p)],
+    env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    for p in ports]
+urls = [f"http://127.0.0.1:{p}" for p in ports]
+
+print("waiting for 2 scan-worker subprocesses ...")
+deadline = time.time() + 90
+for url in urls:
+    while True:
+        try:
+            health = json.loads(text_get(url, "/healthz", timeout=2.0))
+            assert health["role"] == "scan-worker"
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise SystemExit(f"worker {url} never came up")
+            time.sleep(0.3)
+print("workers up:", urls)
+
+m = MetricManager()
+tracer = Tracer()
+fed = Federator(metrics=m)
+for url in urls:
+    fed.add_peer(url)
+srv = GraphServer(g, port=0, federator=fed).start()
+base = f"http://127.0.0.1:{srv.port}"
+
+runner = RemoteScanRunner(urls, cfg, metrics=m, tracer=tracer,
+                          trace_id="smoke-scan", splits_per_worker=6)
+spec = ScanJobSpec("smokejobs:make_slow_count_job")
+result = {}
+errors = []
+
+
+def drive():
+    try:
+        result["metrics"] = runner.run(spec)
+    except BaseException as exc:  # surfaced below
+        errors.append(exc)
+
+
+t = threading.Thread(target=drive, daemon=True)
+t.start()
+
+# wait until BOTH workers have merged at least one split (so both
+# registries are non-empty and the dead worker will leave partial
+# spans in the stitched trace), then federate while both are alive
+# NB: ingested spans carry the worker URL as ``instance``; the
+# Federator's metric label defaults to bare host:port
+instances = {f"127.0.0.1:{p}" for p in ports}
+deadline = time.time() + 60
+while True:
+    done = {(s.attrs or {}).get("instance")
+            for s in (tracer.spans("smoke-scan") or [])
+            if (s.attrs or {}).get("remote")}
+    if set(urls) <= done:
+        break
+    assert time.time() < deadline, f"workers never both merged: {done}"
+    assert t.is_alive() or not errors, errors
+    time.sleep(0.05)
+
+body = http_get(base, "/metrics?federate=1")
+for inst in instances:
+    assert f'instance="{inst}"' in body, f"{inst} missing from federation"
+print("federation carries both instances while alive")
+
+dead_inst = f"127.0.0.1:{ports[0]}"
+procs[0].kill()
+procs[0].wait()
+print("killed worker", dead_inst, "mid-scan")
+
+t.join(timeout=180)
+assert not t.is_alive(), "scan did not finish after worker death"
+if errors:
+    raise errors[0]
+got = result["metrics"]
+assert got.get(VertexCountJob.VERTICES) == N_PEOPLE, got
+assert got.get(VertexCountJob.EDGES) == N_EDGES, got
+assert m.counter_value("scan.remote.splits_redispatched") >= 1
+print("scan survived the kill: counts correct,",
+      int(m.counter_value("scan.remote.splits_redispatched")),
+      "split(s) redispatched")
+
+# ONE stitched trace: every worker span hangs under a coordinator
+# split span; the dead worker's partial spans sit beside the
+# redispatched split span in the same tree
+tree = tracer.tree("smoke-scan")
+assert tree is not None and tree["trace"] == "smoke-scan"
+flat, remote_inst, redispatched = [], set(), 0
+stack = list(tree["spans"])
+while stack:
+    node = stack.pop()
+    flat.append(node)
+    attrs = node.get("attrs") or {}
+    if attrs.get("remote"):
+        remote_inst.add(attrs["instance"])
+        assert node["parent"] is not None or node in tree["spans"]
+    if attrs.get("redispatched"):
+        redispatched += 1
+        assert not attrs.get("remote")
+    stack.extend(node["children"])
+assert redispatched >= 1, "no redispatch span in the stitched trace"
+assert remote_inst == set(urls), \
+    f"dead worker's partial spans missing: {remote_inst}"
+for root in tree["spans"]:
+    assert root["name"] == "split" and \
+        "remote" not in (root.get("attrs") or {})
+print(f"stitched trace: {len(flat)} spans, both instances present, "
+      f"{redispatched} redispatch span(s), "
+      f"{int(m.counter_value('obs.ingest.spans'))} ingested, "
+      f"{int(m.counter_value('obs.ingest.dropped'))} dropped")
+
+# repeated scrapes evict the dead peer; /fleet reports it down
+evicted_row = None
+for _ in range(8):
+    fleet = json.loads(http_get(base, "/fleet"))
+    assert fleet["enabled"] is True
+    rows = {r["instance"]: r for r in fleet["peers"]}
+    if rows[dead_inst]["evicted"]:
+        evicted_row = rows[dead_inst]
+        assert fleet["down"] >= 1
+        assert rows[f"127.0.0.1:{ports[1]}"]["up"] is True
+        break
+    time.sleep(0.1)
+assert evicted_row is not None, "dead peer never evicted"
+assert evicted_row["consecutive_failures"] >= fed.max_failures
+assert m.counter_value("obs.federate.evicted") >= 1
+body = http_get(base, "/metrics?federate=1")
+assert f'instance="{dead_inst}"' not in body, "evicted peer still federated"
+assert f'instance="127.0.0.1:{ports[1]}"' in body
+print("dead peer evicted after", evicted_row["consecutive_failures"],
+      "failures; survivor still federated")
+
+srv.stop()
+procs[1].kill()
+procs[1].wait()
+g.close()
+for s in storage:
+    s.stop()
+print("OK: federation smoke passed")
+EOF

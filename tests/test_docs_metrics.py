@@ -25,8 +25,9 @@ _DOC = os.path.join(_REPO, "docs", "monitoring.md")
 # prefix; {x} keeps f-string placeholders visible for template
 # expansion (device./flightrec. joined serving. in ISSUE 10;
 # controller./scan. in ISSUE 14 — the autotune decision plane and the
-# distributed-scan instrumentation)
-_FAMILIES = r"(?:serving|device|flightrec|controller|scan)"
+# distributed-scan instrumentation; obs. in ISSUE 18 — span ingest +
+# metrics federation)
+_FAMILIES = r"(?:serving|device|flightrec|controller|scan|obs)"
 _LITERAL = re.compile(
     r"""["']f?(""" + _FAMILIES
     + r"""\.[a-z0-9_]+\.[a-z0-9_.{}]+)["']""")
@@ -101,8 +102,19 @@ def test_every_code_metric_documented_and_vice_versa():
                    "serving.interactive.",
                    # ISSUE 14: the autotune decision plane + the
                    # distributed-scan instrumentation
-                   "controller.", "scan.remote."):
+                   "controller.", "scan.remote.",
+                   # ISSUE 18: cross-process span ingest + metrics
+                   # federation
+                   "obs.ingest.", "obs.federate."):
         assert any(n.startswith(family) for n in code), (family, code)
+    # ISSUE 18: the cross-process observability surface must stay in
+    # the scan (created in obs/tracing.ingest and obs/federate)
+    for name in ("obs.ingest.spans", "obs.ingest.dropped",
+                 "obs.ingest.clamped",
+                 "obs.federate.scrapes", "obs.federate.errors",
+                 "obs.federate.evicted",
+                 "obs.federate.series_dropped"):
+        assert name in code, name
     # ISSUE 14: the controller's decision-flow surface must stay in
     # the scan (created in olap/serving/autotune.py)
     for name in ("controller.tick.count",

@@ -1,0 +1,408 @@
+"""Cross-process observability units (ISSUE 18).
+
+The distributed acceptance paths (stitched trace over real worker
+processes, bit-equal propagation on/off) live with the cluster fixture
+in test_scan_worker.py; this file covers the seams in isolation:
+traceparent framing, ``Tracer.ingest`` / ``drain`` (id remap, skew
+normalization, bounds, tap pass-through), the ``Federator`` (instance
+labeling, HELP-once-per-family, type preservation, escaping, series
+cap, stale-peer eviction, fleet roll-up) with an injected fetch + fake
+clock, the FlightRecorder's remote-span bundle section, and the
+GraphServer's ``/metrics?federate=1`` + ``/fleet`` surface.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from titan_tpu.errors import TemporaryBackendError
+from titan_tpu.obs.federate import Federator, _inject_instance
+from titan_tpu.obs.promexport import render_prometheus
+from titan_tpu.obs.tracing import (INGEST_MAX_SPANS, Tracer,
+                                   make_traceparent, parse_traceparent)
+from titan_tpu.utils.metrics import MetricManager
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# -- traceparent framing -----------------------------------------------------
+
+def test_traceparent_round_trip():
+    assert parse_traceparent(make_traceparent("job-7", 42)) == ("job-7", 42)
+    # trace ids are job ids: dashes inside must survive the framing
+    assert parse_traceparent(make_traceparent("a-b-c", 1)) == ("a-b-c", 1)
+
+
+@pytest.mark.parametrize("bad", [
+    None, 17, "", "00--1-01", "garbage", "00-t-x-01", "01-t-1-01",
+    "00-t-1-00", "t-1",
+])
+def test_traceparent_malformed_degrades_to_none(bad):
+    assert parse_traceparent(bad) is None
+
+
+# -- Tracer.ingest / drain ---------------------------------------------------
+
+def _wire(span_id, name, start, end, parent=None, **attrs):
+    w = {"span": span_id, "name": name, "start": start, "end": end}
+    if parent is not None:
+        w["parent"] = parent
+    if attrs:
+        w["attrs"] = attrs
+    return w
+
+
+def test_ingest_remaps_ids_and_attaches_orphans_under_parent():
+    clk = FakeClock()
+    t = Tracer(clk)
+    m = MetricManager()
+    root = t.start("j", "split")
+    # the remote tracer also counted from 1: ids collide numerically
+    batch = [_wire(1, "split", 50.0, 51.0),
+             _wire(2, "execute", 50.2, 50.8, parent=1),
+             _wire(3, "stray", 50.3, 50.4, parent=777)]
+    assert t.ingest("j", batch, parent_id=root.span_id,
+                    offset=0.0, instance="w1", metrics=m) == 3
+    clk.advance(5)
+    t.end(root)
+    spans = {s.span_id: s for s in t.spans("j")}
+    assert len(spans) == 4 and len({s.span_id for s in spans.values()}) == 4
+    by_name = {s.name: s for s in spans.values() if s is not root}
+    # in-batch parent link follows the remap; unshipped parents (the
+    # remote root AND the ring-orphaned stray) attach under the split
+    assert by_name["execute"].parent_id == by_name["split"].span_id
+    assert by_name["split"].parent_id == root.span_id
+    assert by_name["stray"].parent_id == root.span_id
+    for s in by_name.values():
+        assert s.attrs["remote"] is True
+        assert s.attrs["instance"] == "w1"
+    assert m.counter_value("obs.ingest.spans") == 3
+
+
+def test_ingest_applies_offset_and_clamps_into_window():
+    clk = FakeClock(1000.0)
+    t = Tracer(clk)
+    m = MetricManager()
+    root = t.start("j", "split")          # starts at 1000.0
+    clk.advance(2.0)                      # response received at 1002.0
+    # worker clock runs 900s behind; one span leaks past the window
+    batch = [_wire(1, "ok", 100.5, 101.5),
+             _wire(2, "leaky", 99.0, 103.5)]
+    t.ingest("j", batch, parent_id=root.span_id, offset=900.0,
+             window=(1000.0, 1002.0), metrics=m)
+    t.end(root)
+    by_name = {s.name: s for s in t.spans("j")}
+    assert by_name["ok"].t_start == pytest.approx(1000.5)
+    assert by_name["ok"].t_end == pytest.approx(1001.5)
+    # clamped to the coordinator's send/receive envelope
+    assert by_name["leaky"].t_start == 1000.0
+    assert by_name["leaky"].t_end == 1002.0
+    assert m.counter_value("obs.ingest.clamped") == 1
+    assert m.counter_value("obs.ingest.spans") == 2
+
+
+def test_ingest_bounds_and_malformed_spans_counted_as_dropped():
+    t = Tracer(FakeClock())
+    m = MetricManager()
+    root = t.start("j", "split")
+    batch = [_wire(i, f"s{i}", 0.0, 1.0) for i in range(1, 8)]
+    batch.append({"span": "not-an-id", "start": "x"})   # malformed
+    accepted = t.ingest("j", batch, parent_id=root.span_id,
+                        max_spans=5, extra_dropped=2, metrics=m)
+    assert accepted == 5
+    # 3 past the cap (7 - 5 + the malformed one lands in the tail cut?
+    # no: cap slices first, malformed was cut by the cap) + remote's 2
+    assert m.counter_value("obs.ingest.dropped") == 2 + 3
+    assert m.counter_value("obs.ingest.spans") == 5
+
+
+def test_ingest_cannot_evict_the_local_root():
+    t = Tracer(FakeClock(), max_spans=6)
+    m = MetricManager()
+    root = t.start("j", "root")
+    chatty = [_wire(i, f"s{i}", 0.0, 1.0) for i in range(1, 40)]
+    t.ingest("j", chatty, parent_id=root.span_id,
+             max_spans=INGEST_MAX_SPANS, metrics=m)
+    spans = t.spans("j")
+    assert len(spans) == 6
+    assert spans[0] is root               # ring kept the root anchor
+    assert t.dropped("j") > 0
+
+
+def test_ingest_disabled_tracer_accepts_nothing():
+    t = Tracer(enabled=False)
+    m = MetricManager()
+    assert t.ingest("j", [_wire(1, "s", 0.0, 1.0)], parent_id=None,
+                    metrics=m) == 0
+    assert t.spans("j") is None
+    assert m.counter_value("obs.ingest.dropped") == 1
+
+
+def test_ingest_feeds_the_flight_tap():
+    t = Tracer(FakeClock())
+    seen = []
+    t.tap = seen.append
+    root = t.start("j", "split")
+    t.ingest("j", [_wire(1, "remote-exec", 0.0, 1.0)],
+             parent_id=root.span_id, instance="w9")
+    assert [s.name for s in seen] == ["remote-exec"]
+    assert seen[0].attrs["instance"] == "w9"
+
+
+def test_drain_pops_completed_spans_once_and_keeps_open_ones():
+    clk = FakeClock()
+    t = Tracer(clk)
+    open_span = t.start("k", "still-open")
+    for i in range(4):
+        t.event("k", f"done{i}")
+    wire, dropped = t.drain("k", max_spans=3)
+    assert [w["name"] for w in wire] == ["done0", "done1", "done2"]
+    wire2, _ = t.drain("k")
+    assert [w["name"] for w in wire2] == ["done3"]
+    # the open span survives every drain until it completes
+    assert [s.name for s in t.spans("k")] == ["still-open"]
+    t.end(open_span)
+    assert [w["name"] for w in t.drain("k")[0]] == ["still-open"]
+    # fully drained traces are garbage-collected
+    assert t.spans("k") is None
+
+
+# -- Federator ---------------------------------------------------------------
+
+_PEER_A = """\
+# HELP scan_remote_splits_served splits executed on this scan-worker node
+# TYPE scan_remote_splits_served counter
+scan_remote_splits_served 3
+# TYPE serving_queue_depth gauge
+serving_queue_depth 1
+"""
+
+_PEER_B = """\
+# HELP scan_remote_splits_served splits executed on this scan-worker node
+# TYPE scan_remote_splits_served counter
+scan_remote_splits_served 5
+scan_remote_splits_served{kind="repair"} 2
+# TYPE serving_queue_depth gauge
+serving_queue_depth 4
+"""
+
+
+def _fed(fetches, clock=None, **kw):
+    """Federator over a scripted fetch: ``fetches[(instance_url, path)]``
+    is a text body, a callable, or an exception to raise."""
+    def fetch(url, path):
+        got = fetches[(url, path)]
+        if isinstance(got, BaseException):
+            raise got
+        return got() if callable(got) else got
+    return Federator(metrics=MetricManager(), clock=clock or FakeClock(),
+                     fetch=fetch, **kw)
+
+
+def test_federated_render_instance_labels_help_once_types_kept():
+    fetches = {
+        ("http://a:1", "/metrics"): _PEER_A,
+        ("http://a:1", "/healthz"): '{"live": true}',
+        ("http://b:2", "/metrics"): _PEER_B,
+        ("http://b:2", "/healthz"): '{"live": true}',
+    }
+    fed = _fed(fetches)
+    fed.add_peer("http://a:1", instance="a")
+    fed.add_peer("http://b:2", instance="b")
+    assert fed.scrape() == {"a": True, "b": True}
+    local = MetricManager()
+    local.counter("scan.remote.splits_dispatched").inc(9)
+    body = fed.render(render_prometheus(local))
+    # HELP/TYPE once per family across all three sources
+    assert body.count("# TYPE scan_remote_splits_served counter") == 1
+    assert body.count("# HELP scan_remote_splits_served") == 1
+    assert body.count("# TYPE serving_queue_depth gauge") == 1
+    # local samples unlabeled, peer samples instance-labeled
+    assert "scan_remote_splits_dispatched 9" in body
+    assert 'scan_remote_splits_served{instance="a"} 3' in body
+    assert 'scan_remote_splits_served{instance="b"} 5' in body
+    # pre-existing labels keep their pairs, instance lands first
+    assert ('scan_remote_splits_served{instance="b",kind="repair"} 2'
+            in body)
+    # family blocks stay contiguous: every sample of a family sits
+    # between its TYPE line and the next comment line
+    lines = body.splitlines()
+    fam_of = {}
+    cur = None
+    for ln in lines:
+        if ln.startswith("# TYPE "):
+            cur = ln.split()[2]
+        elif ln and not ln.startswith("#"):
+            name = ln.split("{", 1)[0].split(" ", 1)[0]
+            fam_of.setdefault(name, set()).add(cur)
+    assert all(len(v) == 1 for v in fam_of.values()), fam_of
+
+
+def test_federated_instance_label_escaping():
+    assert _inject_instance("x 1", 'a"b\\c\nd') == \
+        'x{instance="a\\"b\\\\c\\nd"} 1'
+    assert _inject_instance('x{} 1', "i") == 'x{instance="i"} 1'
+    assert _inject_instance('x{l="v"} 1', "i") == \
+        'x{instance="i",l="v"} 1'
+
+
+def test_federator_series_cap_drops_and_counts():
+    big = "# TYPE fam counter\n" + "\n".join(
+        f'fam{{k="{i}"}} 1' for i in range(50)) + "\n"
+    fetches = {("http://a:1", "/metrics"): big,
+               ("http://a:1", "/healthz"): "{}"}
+    fed = _fed(fetches, max_series_per_peer=10)
+    fed.add_peer("http://a:1", instance="a")
+    fed.scrape()
+    body = fed.render("")
+    assert body.count('instance="a"') == 10
+    assert fed._metrics.counter_value(
+        "obs.federate.series_dropped") == 40
+
+
+def test_federator_evicts_after_consecutive_failures_and_recovers():
+    state = {"dead": False}
+
+    def maybe(url, path):
+        if state["dead"]:
+            raise TemporaryBackendError("connection refused")
+        return _PEER_A if path == "/metrics" else "{}"
+
+    clk = FakeClock()
+    fed = Federator(metrics=MetricManager(), clock=clk, fetch=maybe,
+                    max_failures=3)
+    fed.add_peer("http://a:1", instance="a")
+    fed.scrape()
+    assert 'instance="a"' in fed.render("")
+    state["dead"] = True
+    fed.scrape(); fed.scrape()
+    # two failures: still cached? no — failures mark but render uses
+    # last text until eviction; the third failure evicts
+    assert not fed.fleet()["peers"][0]["evicted"]
+    fed.scrape()
+    peer = fed.fleet()["peers"][0]
+    assert peer["evicted"] and not peer["up"]
+    assert peer["consecutive_failures"] == 3
+    assert "connection refused" in peer["last_error"]
+    assert 'instance="a"' not in fed.render("")
+    assert fed._metrics.counter_value("obs.federate.evicted") == 1
+    assert fed._metrics.counter_value(
+        "obs.federate.errors", labels={"instance": "a"}) == 3
+    # the worker restarts: one good scrape un-evicts it
+    state["dead"] = False
+    fed.scrape()
+    assert fed.fleet()["peers"][0]["up"]
+    assert 'instance="a"' in fed.render("")
+
+
+def test_fleet_rollup_counts_and_health_passthrough():
+    clk = FakeClock(500.0)
+    fetches = {
+        ("http://a:1", "/metrics"): _PEER_A,
+        ("http://a:1", "/healthz"):
+            '{"live": true, "ready": true, "splits_served": 11}',
+        ("http://b:2", "/metrics"): TemporaryBackendError("down"),
+        ("http://b:2", "/healthz"): TemporaryBackendError("down"),
+    }
+    fed = _fed(fetches, clock=clk)
+    fed.add_peer("http://a:1", instance="a")
+    fed.add_peer("http://b:2", instance="b")
+    fed.scrape()
+    clk.advance(7.0)
+    fl = fed.fleet()
+    assert fl["up"] == 1 and fl["down"] == 1
+    rows = {p["instance"]: p for p in fl["peers"]}
+    assert rows["a"]["up"] and rows["a"]["last_ok_age_s"] == 7.0
+    assert rows["a"]["health"]["splits_served"] == 11
+    assert not rows["b"]["up"] and rows["b"]["consecutive_failures"] == 1
+
+
+# -- FlightRecorder: remote spans in postmortems -----------------------------
+
+def test_postmortem_bundle_carries_ingested_remote_spans(tmp_path):
+    from titan_tpu.obs.flightrec import FlightRecorder
+
+    m = MetricManager()
+    clk = FakeClock()
+    rec = FlightRecorder(str(tmp_path), metrics=m, clock=clk)
+    t = Tracer(clk)
+    t.tap = rec.span_tap
+    root = t.start("job-9", "split")
+    t.ingest("job-9",
+             [_wire(1, "execute", 999.0, 1000.0)],
+             parent_id=root.span_id, instance="http://w:1",
+             extra_dropped=4, metrics=m)
+    t.end(root)
+    # an unrelated local job's remote-free failure must not pick it up
+    t.event("job-other", "round")
+    path = rec.dump(reason="failed", job={"job": "job-9"},
+                    span_tree=t.tree("job-9"))
+    with open(path) as f:
+        bundle = json.load(f)
+    assert [e["name"] for e in bundle["remote_spans"]] == ["execute"]
+    assert bundle["remote_spans"][0]["attrs"]["instance"] == "http://w:1"
+    assert bundle["ingest_dropped"] == 4
+    # a different job's dump excludes this job's remote spans
+    path2 = rec.dump(reason="failed", job={"job": "job-other"})
+    with open(path2) as f:
+        assert json.load(f)["remote_spans"] == []
+
+
+# -- GraphServer surface -----------------------------------------------------
+
+def _get(srv, path):
+    req = urllib.request.Request(
+        f"http://{srv.host}:{srv.port}{path}", method="GET")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+
+@pytest.fixture
+def served():
+    import titan_tpu
+    from titan_tpu.server import GraphServer
+    g = titan_tpu.open({"storage.backend": "inmemory"})
+    srv = GraphServer(g, port=0).start()
+    yield srv
+    srv.stop()
+    g.close()
+
+
+def test_server_fleet_disabled_without_federator(served):
+    code, _, body = _get(served, "/fleet")
+    assert code == 200
+    assert json.loads(body) == {"enabled": False, "peers": []}
+
+
+def test_server_metrics_federate_param(served):
+    fetches = {("http://a:1", "/metrics"): _PEER_A,
+               ("http://a:1", "/healthz"): '{"live": true}'}
+
+    def fetch(url, path):
+        return fetches[(url, path)]
+
+    served.federator = Federator(metrics=MetricManager(),
+                                 clock=FakeClock(), fetch=fetch)
+    served.federator.add_peer("http://a:1", instance="a")
+    # plain /metrics stays local-only
+    code, ctype, body = _get(served, "/metrics")
+    assert code == 200 and ctype.startswith("text/plain")
+    assert 'instance="a"' not in body
+    code, ctype, body = _get(served, "/metrics?federate=1")
+    assert code == 200 and ctype.startswith("text/plain")
+    assert 'scan_remote_splits_served{instance="a"} 3' in body
+    code, _, body = _get(served, "/fleet")
+    fl = json.loads(body)
+    assert fl["enabled"] and fl["up"] == 1
+    assert fl["peers"][0]["health"] == {"live": True}

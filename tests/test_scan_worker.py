@@ -212,9 +212,14 @@ def test_scan_metrics_and_spans_on_failover(cluster):
         assert m.counter_value(
             "scan.remote.worker_failures",
             labels={"url": f"http://{dead_addr}"}) == 1
-        # one completed span per attempt under the reserved "scan" id
-        spans = tracer.spans("scan")
-        assert spans is not None and len(spans) == 5
+        # one completed coordinator span per attempt under the
+        # reserved "scan" id (ISSUE 18 also splices the worker's own
+        # spans in, marked remote=True — filtered out here)
+        all_spans = tracer.spans("scan")
+        assert all_spans is not None
+        spans = [s for s in all_spans
+                 if not (s.attrs or {}).get("remote")]
+        assert len(spans) == 5
         assert all(s.name == "split" and s.t_end is not None
                    for s in spans)
         failed = [s for s in spans if s.attrs.get("redispatched")]
@@ -225,5 +230,149 @@ def test_scan_metrics_and_spans_on_failover(cluster):
         assert len(oks) == 4 and all(
             s.attrs["url"] == f"http://127.0.0.1:{live.port}"
             for s in oks)
+        # ISSUE 18: the dead worker produced no remote spans, but every
+        # merged split shipped its worker half back
+        remote = [s for s in all_spans
+                  if (s.attrs or {}).get("remote")]
+        assert remote and all(
+            s.attrs["instance"] == f"http://127.0.0.1:{live.port}"
+            for s in remote)
     finally:
         live.stop()
+
+
+def test_distributed_scan_yields_one_stitched_trace(cluster):
+    """ISSUE 18 acceptance: a scan fanned out to >= 2 workers yields
+    ONE trace tree with worker split/execute/serialize spans parented
+    under the coordinator's split spans, timestamps monotonic after
+    skew normalization."""
+    from titan_tpu.obs.tracing import Tracer
+    from titan_tpu.utils.metrics import MetricManager
+
+    cfg, workers = cluster
+    _populate(cfg, n_people=24, n_edges=12)
+    m = MetricManager()
+    tracer = Tracer()
+    runner = RemoteScanRunner(
+        [f"127.0.0.1:{w.port}" for w in workers], cfg,
+        metrics=m, tracer=tracer, trace_id="scan-job-1")
+    got = runner.run(ScanJobSpec(
+        "titan_tpu.olap.jobs:make_vertex_count_job"))
+    assert got.get(VertexCountJob.VERTICES) == 24
+
+    tree = tracer.tree("scan-job-1")
+    assert tree is not None and tree["trace"] == "scan-job-1"
+    # every root is a coordinator split span; each carries the worker's
+    # own split span, which carries execute + serialize
+    assert len(tree["spans"]) == 4          # 2 workers x 2 splits
+    instances = set()
+    for coord in tree["spans"]:
+        assert coord["name"] == "split"
+        assert "remote" not in (coord.get("attrs") or {})
+        kids = coord["children"]
+        assert len(kids) == 1 and kids[0]["name"] == "split"
+        wroot = kids[0]
+        assert wroot["attrs"]["remote"] is True
+        instances.add(wroot["attrs"]["instance"])
+        names = sorted(c["name"] for c in wroot["children"])
+        assert names == ["execute", "serialize"]
+        # monotonic after skew normalization: children nest inside
+        # their parent's window, parent inside the coordinator span
+        def nested(parent, node):
+            assert parent["start"] <= node["start"] <= node["end"] \
+                <= parent["end"], (parent["name"], node["name"])
+            for c in node["children"]:
+                nested(node, c)
+        for c in kids:
+            nested(coord, c)
+    # both worker processes contributed spans to the ONE tree
+    assert len(instances) == 2
+    assert m.counter_value("obs.ingest.spans") == 12  # 3 per split
+
+
+def test_scan_results_bit_equal_with_propagation_on_and_off(cluster):
+    """ISSUE 18 acceptance: trace propagation changes what the trace
+    can show, never the scan's results."""
+    from titan_tpu.obs.tracing import Tracer
+    from titan_tpu.utils.metrics import MetricManager
+
+    cfg, workers = cluster
+    _populate(cfg)
+    urls = [f"127.0.0.1:{w.port}" for w in workers]
+    spec = ScanJobSpec("titan_tpu.olap.jobs:make_vertex_count_job")
+    on = RemoteScanRunner(urls, cfg, metrics=MetricManager(),
+                          tracer=Tracer(), propagate=True).run(spec)
+    off_tracer = Tracer()
+    off = RemoteScanRunner(urls, cfg, metrics=MetricManager(),
+                           tracer=off_tracer, propagate=False).run(spec)
+    bare = RemoteScanRunner(urls, cfg,
+                            metrics=MetricManager()).run(spec)
+    assert on._counts == off._counts == bare._counts
+    # propagate=False means the coordinator's own spans still journal,
+    # but nothing remote ever splices in
+    assert all(not (s.attrs or {}).get("remote")
+               for s in off_tracer.spans("scan"))
+
+
+def test_worker_failure_label_cardinality_is_bounded():
+    """ISSUE 18 satellite: ~300 distinct worker urls must degrade via
+    the MAX_CHILDREN path (metrics.labels.dropped counted), not grow
+    unbounded per-{url} children."""
+    from titan_tpu.utils.metrics import MetricManager
+
+    m = MetricManager()
+    n_urls = MetricManager.MAX_CHILDREN + 44       # ~300
+    for i in range(n_urls):
+        m.counter("scan.remote.worker_failures",
+                  labels={"url": f"http://10.0.0.{i}:9{i:03d}"}).inc()
+    kids = m.children("scan.remote.worker_failures")
+    assert len(kids) == MetricManager.MAX_CHILDREN
+    # every increment landed on the parent (degraded ones directly)
+    assert m.counter_value("scan.remote.worker_failures") == n_urls
+    assert m.counter_value(MetricManager.LABELS_DROPPED) == 44
+
+
+def test_worker_get_metrics_and_healthz():
+    """ISSUE 18: workers expose GET /metrics (Prometheus text) and
+    GET /healthz for the federation plane."""
+    import json as _json
+
+    from titan_tpu.utils.httpnode import text_get
+    from titan_tpu.utils.metrics import MetricManager
+
+    m = MetricManager()
+    m.counter("scan.remote.splits_served").inc(7)
+    w = ScanWorkerServer(metrics=m).start()
+    try:
+        body = text_get(w.url, "/metrics")
+        assert "scan_remote_splits_served 7" in body
+        hz = _json.loads(text_get(w.url, "/healthz"))
+        assert hz["live"] and hz["ready"]
+        assert hz["role"] == "scan-worker"
+        assert hz["splits_served"] == 7
+    finally:
+        w.stop()
+
+
+def test_worker_trace_drain_endpoint_is_bounded():
+    """Fire-and-forget pickup: spans a worker journaled but never
+    shipped drain over POST /trace/drain, at most once, bounded."""
+    from titan_tpu.obs.tracing import INGEST_MAX_SPANS
+    from titan_tpu.utils.httpnode import json_call
+
+    w = ScanWorkerServer().start()
+    try:
+        for i in range(5):
+            w.tracer.event("bg", f"tick{i}")
+        res = json_call(w.url, "/trace/drain",
+                        {"trace": "bg", "max_spans": 3})
+        assert [s["name"] for s in res["spans"]] == \
+            ["tick0", "tick1", "tick2"]
+        # a drain pops what it returns; the rest comes next poll
+        res2 = json_call(w.url, "/trace/drain",
+                         {"trace": "bg", "max_spans": INGEST_MAX_SPANS * 9})
+        assert [s["name"] for s in res2["spans"]] == ["tick3", "tick4"]
+        assert json_call(w.url, "/trace/drain",
+                         {"trace": "bg"})["spans"] == []
+    finally:
+        w.stop()

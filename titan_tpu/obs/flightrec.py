@@ -145,6 +145,13 @@ class FlightRecorder:
         rounds = [e for e in evts if e["kind"] == "span"
                   and e["name"] == "round"
                   and (job_id is None or e["trace"] == job_id)]
+        # ingested remote spans (Tracer.ingest marks them remote +
+        # instance, and feeds them through the same tap as local
+        # completions) — a distributed-scan failure dumps the whole
+        # cross-process tree, not just the coordinator half (ISSUE 18)
+        remote = [e for e in evts if e["kind"] == "span"
+                  and (e.get("attrs") or {}).get("remote")
+                  and (job_id is None or e["trace"] == job_id)]
         bundle = {
             "format": BUNDLE_FORMAT,
             "dumped_at": self.clock(),
@@ -154,6 +161,9 @@ class FlightRecorder:
             # the last-N per-round records for THIS job (all jobs when
             # dumped without one) — the "what was it doing" section
             "rounds": rounds[-self.max_rounds_in_dump:],
+            "remote_spans": remote[-self.max_rounds_in_dump:],
+            "ingest_dropped": int(self._metrics.counter_value(
+                "obs.ingest.dropped")),
             "device_events": [e for e in evts
                               if e["kind"] in ("device", "xfer")],
             "compile_log": profiler.compile_log()

@@ -104,6 +104,22 @@ HELP = {
         "scan-worker retirements, by worker url",
     "scan.remote.splits_served":
         "splits executed on this scan-worker node",
+    "obs.ingest.spans":
+        "remote spans spliced into local traces (Tracer.ingest)",
+    "obs.ingest.dropped":
+        "remote spans dropped by the per-call ingest bound, remote "
+        "ring drops included",
+    "obs.ingest.clamped":
+        "ingested spans whose timestamps were clamped into the "
+        "coordinator's send/receive window",
+    "obs.federate.scrapes": "federation scrape attempts, all peers",
+    "obs.federate.errors":
+        "failed federation scrapes, by peer instance",
+    "obs.federate.evicted":
+        "peers evicted from the federated exposition after "
+        "consecutive scrape failures",
+    "obs.federate.series_dropped":
+        "peer samples dropped by the per-peer series cap",
 }
 
 _ILLEGAL = re.compile(r"[^a-zA-Z0-9_:]")

@@ -32,6 +32,40 @@ from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Optional
 
+#: per-call ingest/drain bound: one split response (or drain batch) may
+#: splice at most this many remote spans — overflow is counted, never
+#: spliced, so a chatty worker cannot evict the coordinator's local
+#: spans through sheer volume (docs/observability.md "Cross-process
+#: tracing")
+INGEST_MAX_SPANS = 512
+
+
+def make_traceparent(trace_id: str, span_id) -> str:
+    """W3C-style trace context for the split wire: ``00-<trace
+    id>-<parent span id>-01``. Trace ids here are job ids (arbitrary
+    strings, dashes allowed), span ids are the tracer's integers — the
+    four-field shape and version/flags framing follow the traceparent
+    header so the field order is familiar, not byte-compatible hex."""
+    return f"00-{trace_id}-{int(span_id)}-01"
+
+
+def parse_traceparent(value) -> Optional[tuple]:
+    """``(trace_id, parent_span_id)`` or None for anything malformed —
+    a worker must degrade to untraced execution, never 500, on a bad
+    header."""
+    if not isinstance(value, str):
+        return None
+    parts = value.split("-")
+    if len(parts) < 4 or parts[0] != "00" or parts[-1] != "01":
+        return None
+    trace_id = "-".join(parts[1:-2])
+    if not trace_id:
+        return None
+    try:
+        return trace_id, int(parts[-2])
+    except ValueError:
+        return None
+
 
 class Span:
     """One timed operation. ``attrs`` carry the seam's payload (frontier
@@ -209,6 +243,124 @@ class Tracer:
     def discard(self, trace_id: str) -> None:
         with self._lock:
             self._traces.pop(trace_id, None)
+
+    # -- cross-process seam (ISSUE 18) ---------------------------------------
+
+    def drain(self, trace_id: str,
+              max_spans: int = INGEST_MAX_SPANS) -> tuple:
+        """Pop up to ``max_spans`` COMPLETED spans of a trace as wire
+        dicts (``Span.to_dict`` shape) — the worker side of span
+        shipping: completed spans ride the split response (or a
+        ``/trace/drain`` poll) exactly once, open spans stay journaled
+        for a later drain. Returns ``(wire_spans, dropped)`` where
+        ``dropped`` is the trace's ring-drop count; an empty trace is
+        garbage-collected so fire-and-forget workers don't accumulate
+        dead trace keys."""
+        cap = max(0, int(max_spans))
+        with self._lock:
+            tr = self._traces.get(trace_id)
+            if tr is None:
+                return [], 0
+            take = [s for s in tr.spans if s.t_end is not None][:cap]
+            taken = {id(s) for s in take}
+            tr.spans = [s for s in tr.spans if id(s) not in taken]
+            dropped = tr.dropped
+            if not tr.spans:
+                del self._traces[trace_id]
+        return [s.to_dict() for s in take], dropped
+
+    def ingest(self, trace_id: str, spans, *, parent_id=None,
+               offset: float = 0.0, window=None, instance=None,
+               extra_dropped: int = 0, metrics=None,
+               max_spans: int = INGEST_MAX_SPANS) -> int:
+        """Splice remote COMPLETED spans (wire dicts from :meth:`drain`)
+        into the owning trace; returns the number accepted.
+
+        * **id remap** — remote span ids come from the remote tracer's
+          own counter (same ``count(1)`` as ours, so they collide
+          numerically); every shipped id is remapped to a fresh local
+          id, parent links inside the batch follow the map, and a span
+          whose parent was NOT shipped (the remote root, or a child
+          orphaned by the remote ring) attaches under ``parent_id`` —
+          the coordinator's split span — so the stitched tree never
+          dangles.
+        * **clock-skew normalization** — ``offset`` (remote→local
+          seconds, NTP-style from the request send/receive anchors) is
+          added to every timestamp; with a ``window=(lo, hi)`` the
+          result is additionally clamped into the coordinator's
+          send/receive envelope (clamps counted), so child timestamps
+          stay monotonic under the split span even when the skew
+          estimate is off.
+        * **bounds** — at most ``max_spans`` per call (overflow counted,
+          plus the remote's own ``extra_dropped``), and splicing goes
+          through the same per-trace ring as local spans, so a chatty
+          worker cannot evict the local root.
+
+        Counters (when ``metrics`` is given): ``obs.ingest.spans`` /
+        ``obs.ingest.dropped`` / ``obs.ingest.clamped``. Each accepted
+        span is marked ``remote=True`` + ``instance`` and fed to the
+        flight-recorder ``tap`` like any locally completed span."""
+        batch = list(spans or [])
+        dropped = max(0, int(extra_dropped))
+        if not self.enabled:
+            if metrics is not None and (batch or dropped):
+                metrics.counter("obs.ingest.dropped").inc(
+                    len(batch) + dropped)
+            return 0
+        cap = max(0, int(max_spans))
+        dropped += max(0, len(batch) - cap)
+        batch = batch[:cap]
+        clamped = 0
+        lo, hi = window if window is not None else (None, None)
+        idmap: dict = {}
+        parsed = []
+        for w in batch:
+            try:
+                rid = int(w["span"])
+                t0 = float(w["start"]) + float(offset)
+                t1 = float(w["end"]) + float(offset)
+            except (KeyError, TypeError, ValueError):
+                dropped += 1
+                continue
+            if lo is not None:
+                c0 = min(max(t0, lo), hi)
+                c1 = min(max(t1, lo), hi)
+                if c0 != t0 or c1 != t1:
+                    clamped += 1
+                t0, t1 = c0, c1
+            idmap[rid] = next(self._ids)
+            parsed.append((rid, w, t0, t1))
+        accepted = []
+        with self._lock:
+            tr = self._traces.get(trace_id)
+            if tr is None:
+                while len(self._traces) >= self.max_traces:
+                    self._traces.popitem(last=False)
+                tr = _Trace()
+                self._traces[trace_id] = tr
+            for rid, w, t0, t1 in parsed:
+                attrs = dict(w.get("attrs") or {})
+                attrs["remote"] = True
+                if instance is not None:
+                    attrs["instance"] = instance
+                s = Span(trace_id, idmap[rid],
+                         idmap.get(w.get("parent"), parent_id),
+                         str(w.get("name", "remote")), t0, attrs)
+                s.t_end = t1
+                tr.add(s, self.max_spans)
+                accepted.append(s)
+        tap = self.tap
+        if tap is not None:
+            for s in accepted:
+                tap(s)
+        if metrics is not None:
+            if accepted:
+                metrics.counter("obs.ingest.spans").inc(len(accepted))
+            if dropped:
+                metrics.counter("obs.ingest.dropped").inc(dropped)
+            if clamped:
+                metrics.counter("obs.ingest.clamped").inc(clamped)
+        return len(accepted)
 
     # -- read side -----------------------------------------------------------
 

@@ -25,21 +25,38 @@ failures, and splits served on the worker side; pass ``tracer=`` (an
 ``obs.tracing.Tracer``) to additionally journal one span per split
 under the reserved trace id ``"scan"`` (url, key-range size, ok/error
 — the re-dispatch timeline end to end).
+
+Cross-process tracing (ISSUE 18): with a tracer attached the
+coordinator also stamps a W3C-style ``traceparent`` into every split
+request; the worker runs its OWN tracer, parents its split / execute /
+serialize spans under the propagated context, and ships the completed
+spans back in the split response — the coordinator splices them into
+the owning trace with clock-skew normalization
+(``Tracer.ingest``), so ``GET /trace`` renders ONE tree spanning both
+processes. Workers additionally expose ``GET /metrics`` (Prometheus
+text), ``GET /healthz``, and a bounded ``POST /trace/drain`` for
+fire-and-forget span pickup; ``obs.federate.Federator`` scrapes those
+into the coordinator's ``GET /metrics?federate=1``. Propagation is
+opt-out (``propagate=False``) and changes no scan results — only what
+the trace can show (docs/observability.md "Cross-process tracing").
 """
 
 from __future__ import annotations
 
 import base64
+import itertools
 import queue
 import threading
 import time
 from typing import Optional, Sequence
 
 from titan_tpu.errors import PermanentBackendError, TemporaryBackendError
+from titan_tpu.obs.tracing import (INGEST_MAX_SPANS, Tracer,
+                                   make_traceparent, parse_traceparent)
 from titan_tpu.olap.api import ScanMetrics
 from titan_tpu.olap.distributed import (ScanJobSpec, _merge_metrics,
                                         _run_split, key_splits)
-from titan_tpu.utils.httpnode import JsonNode, json_call
+from titan_tpu.utils.httpnode import JsonNode, TextResponse, json_call
 from titan_tpu.utils.metrics import MetricManager
 
 
@@ -65,10 +82,16 @@ class ScanWorkerServer(JsonNode):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  auth_token: Optional[str] = None,
                  factory_allow: Optional[Sequence[str]] = None,
-                 metrics: Optional[MetricManager] = None):
+                 metrics: Optional[MetricManager] = None,
+                 tracer: Optional[Tracer] = None):
         super().__init__(self._dispatch, host, port, name="scan-worker",
                          auth_token=auth_token)
         self._metrics = metrics or MetricManager.instance()
+        # the worker's OWN span journal: split requests that carry a
+        # traceparent journal under a per-request key and drain into
+        # the response; without one the worker records nothing
+        self.tracer = tracer or Tracer()
+        self._req_ids = itertools.count(1)
         if factory_allow is None:
             import os
             extra = [p.strip() for p in
@@ -86,21 +109,77 @@ class ScanWorkerServer(JsonNode):
                    for p in self.factory_allow)
 
     def _dispatch(self, path: str, req: dict):
+        path = path.split("?", 1)[0]
         if path == "/ping":
             return {"ok": True}
         if path == "/scan":
-            if not self._factory_allowed(str(req["factory"])):
-                raise PermanentBackendError(
-                    f"factory {req['factory']!r} not in the worker's "
-                    "allowlist (TITAN_TPU_SCAN_FACTORIES)")
-            spec = ScanJobSpec(req["factory"], dict(req.get("kwargs") or {}))
-            key_range = (_ub(req["key_start"]), _ub(req["key_end"]))
-            counts = _run_split(dict(req["graph_config"]), spec, key_range,
-                                req.get("store", "edgestore"),
-                                int(req.get("num_threads", 2)))
-            self._metrics.counter("scan.remote.splits_served").inc()
-            return {"counts": {k: int(v) for k, v in counts.items()}}
+            return self._scan(req)
+        if path == "/trace/drain":
+            # bounded pickup for fire-and-forget spans: anything a
+            # worker journaled that never rode a response (the caller
+            # names the trace key it handed out)
+            tid = str(req.get("trace") or "")
+            if not tid:
+                raise ValueError("trace/drain needs {'trace': <id>}")
+            cap = min(int(req.get("max_spans", INGEST_MAX_SPANS)),
+                      INGEST_MAX_SPANS)
+            spans, dropped = self.tracer.drain(tid, max_spans=cap)
+            return {"spans": spans, "dropped": dropped,
+                    "t_now": time.time()}
+        if path == "/metrics":
+            # the federation scrape surface (obs/federate): this
+            # worker's whole registry in Prometheus text
+            from titan_tpu.obs.promexport import (CONTENT_TYPE,
+                                                  render_prometheus)
+            return TextResponse(render_prometheus(self._metrics),
+                                CONTENT_TYPE)
+        if path == "/healthz":
+            return {"live": True, "ready": True, "role": "scan-worker",
+                    "splits_served": int(self._metrics.counter_value(
+                        "scan.remote.splits_served"))}
         raise ValueError(f"unknown path {path!r}")
+
+    def _scan(self, req: dict) -> dict:
+        t_recv = time.time()
+        if not self._factory_allowed(str(req["factory"])):
+            raise PermanentBackendError(
+                f"factory {req['factory']!r} not in the worker's "
+                "allowlist (TITAN_TPU_SCAN_FACTORIES)")
+        # propagated trace context → journal this split's spans under a
+        # per-request key (concurrent splits of one trace must not
+        # drain each other's spans) and ship them back in the response
+        ctx = parse_traceparent(req.get("traceparent"))
+        tracer = self.tracer if ctx is not None and \
+            self.tracer is not None and self.tracer.enabled else None
+        root = ex = None
+        wkey = None
+        if tracer is not None:
+            # the propagated parent span id lives in the COORDINATOR's
+            # id space (numerically colliding with this worker's own
+            # ids), so the worker's root ships parentless — ingest
+            # attaches unshipped parents under the coordinator's split
+            # span, which IS the propagated parent
+            wkey = f"{ctx[0]}#w{next(self._req_ids)}"
+            root = tracer.start(wkey, "split",
+                                factory=str(req["factory"]))
+            ex = tracer.start(wkey, "execute", parent=root)
+        spec = ScanJobSpec(req["factory"], dict(req.get("kwargs") or {}))
+        key_range = (_ub(req["key_start"]), _ub(req["key_end"]))
+        counts = _run_split(dict(req["graph_config"]), spec, key_range,
+                            req.get("store", "edgestore"),
+                            int(req.get("num_threads", 2)))
+        self._metrics.counter("scan.remote.splits_served").inc()
+        if tracer is None:
+            return {"counts": {k: int(v) for k, v in counts.items()}}
+        tracer.end(ex)
+        ser = tracer.start(wkey, "serialize", parent=root)
+        out = {"counts": {k: int(v) for k, v in counts.items()}}
+        tracer.end(ser)
+        tracer.end(root)
+        spans, dropped = tracer.drain(wkey)
+        out["trace"] = {"spans": spans, "dropped": dropped,
+                        "t_recv": t_recv, "t_send": time.time()}
+        return out
 
 
 class RemoteScanRunner:
@@ -111,7 +190,8 @@ class RemoteScanRunner:
                  store: str = "edgestore", threads_per_worker: int = 2,
                  splits_per_worker: int = 2, timeout: float = 600.0,
                  metrics: Optional[MetricManager] = None,
-                 tracer=None):
+                 tracer=None, trace_id: str = "scan",
+                 propagate: bool = True):
         if not workers:
             raise ValueError("RemoteScanRunner needs at least one worker")
         self.workers = [w if "://" in w else f"http://{w}" for w in workers]
@@ -121,18 +201,48 @@ class RemoteScanRunner:
         self.splits_per_worker = splits_per_worker
         self.timeout = timeout
         self._metrics = metrics or MetricManager.instance()
-        # optional span journal (obs/tracing.Tracer): one event per
-        # split attempt under the reserved "scan" trace id
+        # optional span journal (obs/tracing.Tracer): one span per
+        # split attempt under ``trace_id`` (default: the reserved
+        # "scan" trace); with ``propagate`` the split's span id also
+        # rides the request as a traceparent and the worker's spans
+        # come back spliced under it (Tracer.ingest)
         self._tracer = tracer
+        self.trace_id = trace_id
+        self.propagate = bool(propagate)
 
-    def _split_event(self, url: str, t0: float, **attrs) -> None:
-        """One completed ``split`` span under the reserved ``"scan"``
-        trace id (when a tracer is attached) — dispatch→outcome wall
-        time with the worker url, so a dead worker's re-dispatch is a
-        visible timeline, not an inference from totals."""
-        if self._tracer is not None:
-            self._tracer.event("scan", "split", t0=t0, t1=time.time(),
-                               url=url, **attrs)
+    def _start_split(self, url: str):
+        """Open the per-attempt ``split`` span (None without a tracer)
+        — a dead worker's re-dispatch stays a visible timeline, not an
+        inference from totals."""
+        if self._tracer is None or not self._tracer.enabled:
+            return None
+        return self._tracer.start(self.trace_id, "split", url=url)
+
+    def _end_split(self, span, **attrs) -> None:
+        if span is not None:
+            self._tracer.end(span, **attrs)
+
+    def _ingest_trace(self, res: dict, span, url: str,
+                      t0: float, t1: float) -> None:
+        """Splice the worker's shipped spans under this attempt's split
+        span. Skew anchor: the coordinator knows it sent at ``t0`` and
+        received at ``t1``; the worker stamped its own receive/send —
+        the NTP-style midpoint difference is the remote→local offset,
+        and (t0, t1) is the clamp window that keeps the stitched tree
+        monotonic even when that estimate is off."""
+        wire = res.get("trace") if isinstance(res, dict) else None
+        if wire is None or span is None:
+            return
+        try:
+            offset = ((t0 + t1) - (float(wire["t_recv"])
+                                   + float(wire["t_send"]))) / 2.0
+        except (KeyError, TypeError, ValueError):
+            offset = 0.0
+        self._tracer.ingest(
+            self.trace_id, wire.get("spans") or [],
+            parent_id=span.span_id, offset=offset, window=(t0, t1),
+            instance=url, extra_dropped=int(wire.get("dropped") or 0),
+            metrics=self._metrics)
 
     def run(self, spec: ScanJobSpec, idm=None) -> ScanMetrics:
         if idm is None:
@@ -170,18 +280,28 @@ class RemoteScanRunner:
                 except queue.Empty:
                     continue
                 m.counter("scan.remote.splits_dispatched").inc()
-                t0 = time.time()
+                span = self._start_split(url)
+                # skew anchors in the TRACER's clock domain (injectable
+                # clock preserved): the NTP-style offset in
+                # _ingest_trace maps worker wall time into whatever
+                # clock this tracer runs on
+                t0 = span.t_start if span is not None else time.time()
+                payload = {
+                    "graph_config": self.graph_config,
+                    "factory": spec.factory, "kwargs": spec.kwargs,
+                    "key_start": _b(key_range[0]),
+                    "key_end": _b(key_range[1]),
+                    "store": self.store,
+                    "num_threads": self.threads_per_worker,
+                }
+                if span is not None and self.propagate:
+                    payload["traceparent"] = make_traceparent(
+                        self.trace_id, span.span_id)
                 try:
-                    res = json_call(url, "/scan", {
-                        "graph_config": self.graph_config,
-                        "factory": spec.factory, "kwargs": spec.kwargs,
-                        "key_start": _b(key_range[0]),
-                        "key_end": _b(key_range[1]),
-                        "store": self.store,
-                        "num_threads": self.threads_per_worker,
-                    }, timeout=self.timeout)
+                    res = json_call(url, "/scan", payload,
+                                    timeout=self.timeout)
                 except PermanentBackendError as e:
-                    self._split_event(url, t0, error=f"permanent: {e}")
+                    self._end_split(span, error=f"permanent: {e}")
                     with lock:
                         fatal.append(e)
                         done.set()
@@ -195,16 +315,19 @@ class RemoteScanRunner:
                     m.counter("scan.remote.splits_redispatched").inc()
                     m.counter("scan.remote.worker_failures",
                               labels={"url": url}).inc()
-                    self._split_event(url, t0, redispatched=True,
-                                      error=f"{type(e).__name__}: {e}")
+                    self._end_split(span, redispatched=True,
+                                    error=f"{type(e).__name__}: {e}")
                     with lock:
                         errors.append(e)
                         alive[0] -= 1
                         if alive[0] == 0:
                             done.set()   # no one left to drain the queue
                     return
+                t1 = self._tracer.clock() if span is not None \
+                    else time.time()
                 m.counter("scan.remote.splits_merged").inc()
-                self._split_event(url, t0, ok=True)
+                self._ingest_trace(res, span, url, t0, t1)
+                self._end_split(span, ok=True)
                 with lock:
                     results.append(res["counts"])
                     remaining[0] -= 1

@@ -111,12 +111,24 @@ Endpoints:
   GET  /metrics     — Prometheus text exposition of every registered
                       counter/timer/histogram/gauge, labeled children
                       included (titan_tpu/obs/promexport;
-                      content type ``text/plain; version=0.0.4``)
+                      content type ``text/plain; version=0.0.4``).
+                      With ``?federate=1`` and a Federator attached
+                      (obs/federate), registered peers' registries are
+                      scraped and merged in under ``instance`` labels —
+                      one scrape target for the whole fleet
+  GET  /fleet       — federation health roll-up: per registered peer,
+                      up/evicted/consecutive-failures + its own
+                      /healthz body; {"enabled": false} without a
+                      Federator (docs/monitoring.md)
   GET  /trace?job=<id> — the job's span tree as JSON (obs/tracing:
                       submit→queue→fuse→per-round→checkpoint→retrying→
                       resume→terminal; 404 for unknown traces; the
                       reserved id ``live`` holds the live plane's
-                      apply/compaction timeline). Each ``GET /jobs``
+                      apply/compaction timeline; distributed scans
+                      return ONE stitched tree — remote worker spans
+                      spliced under the coordinator's split spans via
+                      Tracer.ingest, marked ``remote``/``instance``).
+                      Each ``GET /jobs``
                       entry also carries a ``trace`` digest
                       (queue_ms / fuse_ms / device_ms / rounds).
                       docs/observability.md documents the span model.
@@ -216,7 +228,8 @@ class GraphServer:
     credential gate for a script-evaluating endpoint."""
 
     def __init__(self, graph, host: str = "127.0.0.1", port: int = 8182,
-                 auth_token: Optional[str] = None, scheduler=None):
+                 auth_token: Optional[str] = None, scheduler=None,
+                 federator=None):
         self.graph = graph
         self.host = host
         self.port = port
@@ -225,6 +238,10 @@ class GraphServer:
         self._thread: Optional[threading.Thread] = None
         self._scheduler = scheduler
         self._sched_lock = threading.Lock()
+        # optional obs.federate.Federator: when attached,
+        # GET /metrics?federate=1 merges registered peers' registries
+        # under instance labels and GET /fleet rolls up their health
+        self.federator = federator
 
     # -- async job plane (olap/serving) --------------------------------------
 
@@ -528,12 +545,29 @@ class GraphServer:
                         self._send(200, {"enabled": True,
                                          "dump_dir": rec.dump_dir,
                                          "dumps": rec.index()})
-                elif self.path == "/metrics":
+                elif self.path.split("?", 1)[0] == "/metrics":
+                    from urllib.parse import parse_qs, urlparse
                     from titan_tpu.obs.promexport import (CONTENT_TYPE,
                                                           render_prometheus)
-                    self._send_text(
-                        200, render_prometheus(server.metrics_manager()),
-                        CONTENT_TYPE)
+                    body = render_prometheus(server.metrics_manager())
+                    q = parse_qs(urlparse(self.path).query)
+                    fed = server.federator
+                    if fed is not None and (q.get("federate")
+                                            or ["0"])[0] not in (
+                                                "0", "", "false"):
+                        # scrape-then-render so the merged body is one
+                        # coherent round across the fleet
+                        fed.scrape()
+                        body = fed.render(body)
+                    self._send_text(200, body, CONTENT_TYPE)
+                elif self.path == "/fleet":
+                    fed = server.federator
+                    if fed is None:
+                        self._send(200, {"enabled": False, "peers": []})
+                    else:
+                        fed.scrape()
+                        self._send(200, {"enabled": True,
+                                         **fed.fleet()})
                 elif self.path.split("?", 1)[0] == "/trace":
                     from urllib.parse import parse_qs, urlparse
                     q = parse_qs(urlparse(self.path).query)

@@ -23,6 +23,20 @@ def _env_token() -> Optional[str]:
     return os.environ.get("TITAN_TPU_NODE_TOKEN") or None
 
 
+class TextResponse:
+    """Dispatch return type for non-JSON GET bodies — a node handler
+    returns one when the payload is a text protocol (the Prometheus
+    exposition on a scan worker's ``GET /metrics``), and the shell
+    sends it verbatim with the given content type instead of
+    json-encoding it."""
+
+    __slots__ = ("text", "content_type")
+
+    def __init__(self, text: str, content_type: str = "text/plain"):
+        self.text = text
+        self.content_type = content_type
+
+
 class JsonNode:
     """HTTP server shell around a ``dispatch(path, request_dict)`` callable.
 
@@ -58,7 +72,7 @@ class JsonNode:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def do_POST(self):
+            def _authorized(self) -> bool:
                 # constant-time compare: this is the mesh-auth boundary,
                 # a plain != leaks token prefixes through timing. Bytes,
                 # not str: compare_digest raises on non-ASCII str input
@@ -70,6 +84,22 @@ class JsonNode:
                         f"Bearer {node.auth_token}".encode(
                             "utf-8", "surrogateescape")):
                     self._send(401, {"error": "missing or bad bearer token"})
+                    return False
+                return True
+
+            def _reply(self, result) -> None:
+                if isinstance(result, TextResponse):
+                    body = result.text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", result.content_type)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._send(200, result)
+
+            def do_POST(self):
+                if not self._authorized():
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 try:
@@ -81,7 +111,23 @@ class JsonNode:
                 except Exception as e:   # noqa: BLE001 — wire boundary
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
                     return
-                self._send(200, result)
+                self._reply(result)
+
+            def do_GET(self):
+                # the observation surface (ISSUE 18: /metrics, /healthz
+                # on scan workers) — same auth gate and error taxonomy
+                # as POST, empty request dict, path carries any query
+                if not self._authorized():
+                    return
+                try:
+                    result = node._dispatch(self.path, {})
+                except TemporaryBackendError as e:
+                    self._send(503, {"error": str(e)})
+                    return
+                except Exception as e:   # noqa: BLE001 — wire boundary
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._reply(result)
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
@@ -125,6 +171,28 @@ def json_call(url: str, path: str, payload: dict,
     except (urllib.error.URLError, OSError) as e:
         # connection failures are retryable (reference: thrift pool
         # rebuild + BackendOperation retries)
+        raise TemporaryBackendError(str(e)) from e
+
+
+def text_get(url: str, path: str, timeout: float = 10.0,
+             token: Optional[str] = None) -> str:
+    """GET a text endpoint (a peer's ``/metrics`` exposition or
+    ``/healthz`` JSON) with the same bearer-token defaulting and error
+    taxonomy as :func:`json_call` — the Federator's default fetch."""
+    headers = {}
+    token = _env_token() if token is None else (token or None)
+    if token is not None:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(url + path, headers=headers,
+                                 method="GET")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read().decode("utf-8", "replace")
+    except urllib.error.HTTPError as e:
+        if e.code == 503:
+            raise TemporaryBackendError(str(e)) from e
+        raise PermanentBackendError(str(e)) from e
+    except (urllib.error.URLError, OSError) as e:
         raise TemporaryBackendError(str(e)) from e
 
 
