@@ -75,6 +75,8 @@ _EST = {
     "segment_pallas": (60, 0.1),   # synthetic [E] array, two kernels
     "distributed_scan": (30, 0.0),  # host-only: 2 HTTP workers, tiny
                                     # graph, no device work at all
+    "fleet": (45, 0.0),             # host-only: router + 2 in-process
+                                    # replicas, CPU frontier kernels
 }
 # nominal fast-day H2D rate (GB/s): bfs26's 9GB uploaded in 16.35s
 # (BENCH_r05); the headline stage's measured upload re-prices this
@@ -1372,6 +1374,141 @@ def distributed_scan_stage(rep: Report) -> None:
     rep.emit()
 
 
+def fleet_stage(rep: Report) -> None:
+    """ISSUE 19 (ROADMAP #2/#5): replica-fleet routing evidence. A
+    FleetRouter over two in-process replicas (full GraphServer +
+    JobScheduler each) on shared remote-cluster storage, driven by a
+    mixed BFS/SSSP/WCC stream — records per-replica occupancy and
+    routing-decision counts — then one deterministic failover (a
+    never-starting victim scheduler, so the kill always lands mid-
+    flight) for the redispatch-latency line. Small CPU frontier
+    kernels + host HTTP: runs on CPU and chip days alike."""
+    import tempfile
+
+    import titan_tpu
+    from titan_tpu.olap.fleet.replica import build
+    from titan_tpu.olap.fleet.router import FleetRouter
+    from titan_tpu.storage.inmemory import InMemoryStoreManager
+    from titan_tpu.storage.remote import KCVSServer
+    from titan_tpu.utils.httpnode import json_call, text_get
+    from titan_tpu.utils.metrics import MetricManager
+
+    n, m_edges = 192, 900
+    storage = KCVSServer(InMemoryStoreManager()).start()
+    cfg = {"storage.backend": "remote-cluster",
+           "storage.hostname": [f"127.0.0.1:{storage.port}"]}
+    g = titan_tpu.open(cfg)
+    tx = g.new_transaction()
+    vs = [tx.add_vertex("node", name=f"v{i}") for i in range(n)]
+    rng = np.random.default_rng(42)
+    for _ in range(m_edges):
+        a, b = rng.integers(0, n, 2)
+        tx.add_edge(vs[int(a)], "link", vs[int(b)])
+    tx.commit()
+    ids = [v.id for v in vs]
+    g.close()
+    ck = tempfile.mkdtemp(prefix="bench-fleet-")
+
+    def drive(router, jids, deadline_s=120.0):
+        t_end = time.time() + deadline_s
+        terminal = ("done", "failed", "timeout", "cancelled",
+                    "expired")
+        while True:
+            router.pump()
+            states = [json.loads(text_get(
+                router.url, f"/jobs/{j}"))["state"] for j in jids]
+            if all(s in terminal for s in states):
+                return states
+            if time.time() > t_end:
+                raise AssertionError(f"fleet stream stalled: {states}")
+            time.sleep(0.05)
+
+    # phase 1 — mixed stream routing over two live replicas
+    reps = [build({"graph": cfg, "checkpoint_dir": ck})
+            for _ in range(2)]
+    for _g, _s, srv in reps:
+        srv.start()
+    mm = MetricManager()
+    router = FleetRouter(metrics=mm, autotune="shadow",
+                         autopump=False)
+    insts = []
+    for i, (_g, _s, srv) in enumerate(reps):
+        inst = f"replica-{i}"
+        router.add_replica(f"http://{srv.host}:{srv.port}",
+                           instance=inst)
+        insts.append(inst)
+    router.start()
+    try:
+        stream = ([{"kind": "bfs", "source": ids[k]}
+                   for k in (0, 3, 7, 11)]
+                  + [{"kind": "sssp", "source": ids[k]}
+                     for k in (1, 5, 9, 13)]
+                  + [{"kind": "wcc"} for _ in range(4)])
+        t0 = time.time()
+        jids = [json_call(router.url, "/jobs", body)["job"]
+                for body in stream]
+        states = drive(router, jids)
+        stream_wall = time.time() - t0
+        if states.count("done") != len(stream):
+            raise AssertionError(f"mixed stream not all done: {states}")
+        routed = {inst: int(mm.counter_value(
+            "serving.fleet.routed", labels={"instance": inst}))
+            for inst in insts}
+        decisions = int(mm.counter_value("serving.fleet.routed"))
+    finally:
+        router.stop()
+        for _g, _s, srv in reps:
+            _s.close()
+            srv.stop()
+        for _g, _s, _srv in reps:
+            _g.close()
+
+    # phase 2 — one deterministic failover for the latency line
+    gv, sv, srvv = build({"graph": cfg, "checkpoint_dir": ck,
+                          "scheduler": {"autostart": False}})
+    gs, ss, srvs = build({"graph": cfg, "checkpoint_dir": ck})
+    srvv.start(); srvs.start()
+    m2 = MetricManager()
+    router = FleetRouter(metrics=m2, autotune="off", autopump=False)
+    router.add_replica(f"http://{srvv.host}:{srvv.port}",
+                       instance="a-victim")
+    router.add_replica(f"http://{srvs.host}:{srvs.port}",
+                       instance="b-survivor")
+    router.start()
+    try:
+        jid = json_call(router.url, "/jobs",
+                        {"kind": "bfs", "source": ids[0]})["job"]
+        router.pump()
+        srvv.stop()
+        drive(router, [jid])
+        w = json.loads(text_get(router.url, f"/jobs/{jid}"))
+        if w["state"] != "done" or w["attempts"] != 2:
+            raise AssertionError(f"failover did not redispatch: {w}")
+        hs = m2.histogram_stats(
+            "serving.fleet.redispatch_latency_ms") or {}
+    finally:
+        router.stop()
+        sv.close(); ss.close()
+        srvs.stop()
+        gv.close(); gs.close()
+        storage.stop()
+
+    lo, hi = min(routed.values()), max(routed.values())
+    rep.detail["fleet"] = {
+        "replicas": 2,
+        "stream_jobs": len(stream),
+        "stream_mix": {"bfs": 4, "sssp": 4, "wcc": 4},
+        "stream_wall_s": round(stream_wall, 3),
+        "routing_decisions": decisions,
+        "per_replica_routed": routed,
+        "occupancy_spread": round((hi - lo) / max(hi, 1), 4),
+        "redispatches":
+            int(m2.counter_value("serving.fleet.redispatches")),
+        "redispatch_latency_ms": round(hs.get("mean", 0.0), 3),
+    }
+    rep.emit()
+
+
 class Evidence:
     """``--evidence <path>`` (ISSUE 10, ROADMAP #5): wrap every stage
     in the device-cost profiler and write ONE machine-readable bundle
@@ -1518,6 +1655,14 @@ class Evidence:
                 present(det["distributed_scan"])
                 if det.get("distributed_scan") is not None
                 else absent("distributed_scan")),
+            # ISSUE 19 (ROADMAP #2): the replica fleet's routing plane —
+            # per-replica occupancy + decision counts under a mixed
+            # stream and the failover redispatch latency, or the
+            # stage's recorded skip reason
+            "fleet_routing": (
+                present(det["fleet"])
+                if det.get("fleet") is not None
+                else absent("fleet")),
         }
 
     def write(self) -> None:
@@ -1636,6 +1781,11 @@ def main() -> None:
         # distributed-scan trace + ingest accounting — host-only HTTP
         # against dict stores, so it runs on CPU and chip days alike
         ("distributed_scan", lambda: distributed_scan_stage(rep)),
+        # replica-fleet routing evidence (ISSUE 19): per-replica
+        # occupancy + routing decisions under a mixed BFS/SSSP/WCC
+        # stream, and the failover redispatch-latency line — host HTTP
+        # + small CPU kernels, runs on CPU and chip days alike
+        ("fleet", lambda: fleet_stage(rep)),
         # Pallas kernel verdicts (ISSUE 16): the fused bottom-up
         # frontier kernel and the one-pass segment scan vs their XLA
         # paths — chip-only (interpreter mode times an XLA emulation)

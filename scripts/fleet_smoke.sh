@@ -1,0 +1,214 @@
+#!/usr/bin/env bash
+# Fleet failover smoke (ISSUE 19): a FleetRouter in front of three REAL
+# replica subprocesses (full GraphServer + JobScheduler each) over
+# shared remote-cluster storage and a shared checkpoint directory. A
+# long-chain BFS is submitted through the router with per-round
+# checkpoints; the dispatched replica is SIGKILLed mid-run. Asserts,
+# end to end:
+#
+#   * the job completes BIT-EQUAL on a survivor (the chain's known
+#     distance), re-dispatched once under the SAME idempotency key —
+#     the survivor ADOPTS the dead replica's newest ``idem-<key>``
+#     checkpoint (serving_recovery_resumes visible under the
+#     survivor's instance label in /metrics?federate=1) and
+#     rounds_replayed stays bounded by the checkpoint cadence;
+#   * ``serving.jobs.submitted`` stays at 1 (admission-time counting —
+#     the redispatch counts serving.fleet.redispatches instead);
+#   * GET /fleet reports the corpse down after the kill, then UP again
+#     once the replica process is restarted on the same port
+#     (consecutive-failure eviction un-evicts on recovery);
+#   * the stitched trace holds BOTH dispatch attempts under one root —
+#     the first marked redispatched with the dead replica's partial
+#     remote spans still parented under it.
+#
+# Usage: scripts/fleet_smoke.sh   (CPU-safe; ~90s incl. three replica
+# subprocess startups)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu exec python - <<'EOF'
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import titan_tpu
+from titan_tpu.olap.fleet.router import FleetRouter
+from titan_tpu.storage.inmemory import InMemoryStoreManager
+from titan_tpu.storage.remote import KCVSServer
+from titan_tpu.utils.httpnode import json_call, text_get
+from titan_tpu.utils.metrics import MetricManager
+
+N_CHAIN = 900           # BFS depth == N_CHAIN - 1 rounds: slow enough
+KILL_AFTER_ROUND = 10   # that round 10 is observed long before the end
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+storage = KCVSServer(InMemoryStoreManager()).start()
+gcfg = {"storage.backend": "remote-cluster",
+        "storage.hostname": [f"127.0.0.1:{storage.port}"]}
+
+g = titan_tpu.open(gcfg)
+tx = g.new_transaction()
+vs = [tx.add_vertex("node", name=f"n{i}") for i in range(N_CHAIN)]
+for a, b in zip(vs, vs[1:]):
+    tx.add_edge(a, "next", b)
+tx.commit()
+ids = [v.id for v in vs]
+print(f"chain graph loaded: {N_CHAIN} vertices over shared storage")
+
+ck = tempfile.mkdtemp(prefix="fleetsmoke-ck-")
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=os.getcwd() + os.pathsep
+           + os.environ.get("PYTHONPATH", ""))
+ports = [free_port() for _ in range(3)]
+
+
+def spawn(i):
+    cfg = {"graph": gcfg, "checkpoint_dir": ck,
+           "host": "127.0.0.1", "port": ports[i],
+           "instance": f"replica-{i}"}
+    return subprocess.Popen(
+        [sys.executable, "-m", "titan_tpu.olap.fleet.replica",
+         json.dumps(cfg)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def await_up(url, deadline):
+    while True:
+        try:
+            health = json.loads(text_get(url, "/healthz", timeout=2.0))
+            assert health["live"]
+            return
+        except Exception:
+            if time.time() > deadline:
+                raise SystemExit(f"replica {url} never came up")
+            time.sleep(0.3)
+
+
+procs = {f"replica-{i}": spawn(i) for i in range(3)}
+urls = {f"replica-{i}": f"http://127.0.0.1:{ports[i]}"
+        for i in range(3)}
+print("waiting for 3 replica subprocesses ...")
+deadline = time.time() + 120
+for url in urls.values():
+    await_up(url, deadline)
+print("replicas up:", list(urls.values()))
+
+m = MetricManager()
+router = FleetRouter(metrics=m, autotune="shadow", autopump=True)
+for inst, url in urls.items():
+    router.add_replica(url, instance=inst)
+router.start()
+base = router.url
+
+out = json_call(base, "/jobs",
+                {"kind": "bfs", "source": ids[0],
+                 "targets": [ids[-1]], "checkpoint_every": 1})
+jid, victim = out["job"], out["replica"]
+print(f"job {jid} routed to {victim}")
+
+# wait until the victim has durably checkpointed a few rounds, then
+# SIGKILL it mid-BFS — with ~900 rounds left it is ALWAYS mid-run here
+deadline = time.time() + 90
+while True:
+    w = json.loads(text_get(base, f"/jobs/{jid}"))
+    ckr = (w.get("remote") or {}).get("checkpoint_round")
+    if ckr is not None and ckr >= KILL_AFTER_ROUND:
+        break
+    assert w["state"] not in ("done", "failed"), \
+        f"job finished before the kill window: {w}"
+    assert time.time() < deadline, f"no checkpoints observed: {w}"
+    time.sleep(0.05)
+procs[victim].kill()
+procs[victim].wait()
+print(f"SIGKILLed {victim} at checkpoint round {ckr}")
+
+deadline = time.time() + 120
+while True:
+    w = json.loads(text_get(base, f"/jobs/{jid}"))
+    if w["state"] in ("done", "failed", "timeout", "cancelled"):
+        break
+    assert time.time() < deadline, f"failover never completed: {w}"
+    time.sleep(0.1)
+assert w["state"] == "done", w
+assert w["replica"] != victim and w["replica"] in urls
+assert w["attempts"] == 2, w
+# bit-equal completion: the chain's only distance to its tail
+assert w["remote"]["result"]["targets"] == {str(ids[-1]): N_CHAIN - 1}
+assert w["remote"].get("rounds_replayed", 0) <= 2, w
+print(f"survivor {w['replica']} finished bit-equal "
+      f"(distance {N_CHAIN - 1}), attempts=2")
+
+assert m.counter_value("serving.jobs.submitted") == 1
+assert m.counter_value("serving.fleet.redispatches") == 1
+print("counters: submitted=1 (no double count), redispatches=1")
+
+# the survivor ADOPTED the dead replica's checkpoint: its registry
+# counts a resume, re-exported under its instance label
+body = text_get(base, "/metrics?federate=1")
+resumed = [ln for ln in body.splitlines()
+           if ln.startswith("serving_recovery_resumes")
+           and f'instance="{w["replica"]}"' in ln]
+assert resumed and float(resumed[0].rsplit(" ", 1)[1]) >= 1, \
+    "survivor never resumed from the shared checkpoint"
+print("survivor resumed from the dead replica's checkpoint:",
+      resumed[0])
+
+# fleet view: the corpse is down ...
+fl = json.loads(text_get(base, "/fleet"))
+rows = {p["instance"]: p for p in fl["peers"]}
+assert not rows[victim]["up"] and fl["down"] >= 1
+assert rows[w["replica"]]["up"]
+print(f"/fleet reports {victim} down, {w['replica']} up")
+
+# ... then recovered once the process is restarted on the same port
+procs[victim] = spawn(int(victim.rsplit("-", 1)[1]))
+await_up(urls[victim], time.time() + 120)
+deadline = time.time() + 60
+while True:
+    fl = json.loads(text_get(base, "/fleet"))
+    rows = {p["instance"]: p for p in fl["peers"]}
+    if rows[victim]["up"]:
+        break
+    assert time.time() < deadline, f"{victim} never un-evicted: {rows}"
+    time.sleep(0.2)
+print(f"/fleet reports {victim} recovered after restart")
+
+# stitched trace: both dispatch attempts under one root, the first
+# marked redispatched, the dead replica's partial spans preserved
+tree = json.loads(text_get(base, f"/trace?job={jid}"))
+flat, stack = [], list(tree["spans"])
+while stack:
+    node = stack.pop()
+    flat.append(node)
+    stack.extend(node.get("children", []))
+disp = [s for s in flat if s["name"] == "dispatch"]
+attrs = [s.get("attrs") or {} for s in disp]
+assert len(disp) == 2
+assert sum(1 for a in attrs if a.get("redispatched")) == 1
+dead_remote = [s for s in flat
+               if (s.get("attrs") or {}).get("instance") == victim
+               and (s.get("attrs") or {}).get("remote")]
+assert dead_remote, "dead replica's partial spans missing"
+print(f"stitched trace: {len(flat)} spans, 2 dispatch attempts, "
+      f"{len(dead_remote)} partial span(s) from the corpse")
+
+router.stop()
+for p in procs.values():
+    p.kill()
+    p.wait()
+g.close()
+storage.stop()
+print("OK: fleet smoke passed")
+EOF

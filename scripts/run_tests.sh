@@ -7,15 +7,17 @@
 # install -e .[test]). Override workers with PYTEST_WORKERS=N; extra
 # args pass through. SKIP_LINT=1 skips the standalone lint gate (the
 # invariants still run inside the suite as tests/test_lint.py).
-# RUN_SMOKES=1 additionally runs the cross-process federation smoke
-# (scripts/federation_smoke.sh — real worker subprocesses, ~60s)
-# after the suite passes.
+# RUN_SMOKES=1 additionally runs the cross-process smokes after the
+# suite passes: the federation smoke (scripts/federation_smoke.sh —
+# real scan-worker subprocesses, ~60s) and the fleet failover smoke
+# (scripts/fleet_smoke.sh — real replica subprocesses, ~90s).
 set -euo pipefail
 if [[ "${SKIP_LINT:-0}" != "1" ]]; then
   "$(dirname "$0")/lint.sh"
 fi
 if [[ "${RUN_SMOKES:-0}" == "1" ]]; then
   python -m pytest -n "${PYTEST_WORKERS:-4}" "$@"
-  exec "$(dirname "$0")/federation_smoke.sh"
+  "$(dirname "$0")/federation_smoke.sh"
+  exec "$(dirname "$0")/fleet_smoke.sh"
 fi
 exec python -m pytest -n "${PYTEST_WORKERS:-4}" "$@"

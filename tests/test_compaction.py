@@ -213,7 +213,9 @@ def test_op_scan_ban_auto_discovers_the_tree():
     for pkg in ("titan_tpu/olap/serving/",
                 "titan_tpu/olap/serving/interactive/",
                 "titan_tpu/olap/recovery/", "titan_tpu/olap/live/",
-                "titan_tpu/obs/", "titan_tpu/parallel/"):
+                "titan_tpu/obs/", "titan_tpu/parallel/",
+                # ISSUE 19: the fleet tier joined with zero config
+                "titan_tpu/olap/fleet/"):
         assert any(p.startswith(pkg) for p in scanned), pkg
     # the exemptions stay visible: suppressed findings with reasons
     exempt = [f for f in result.findings
@@ -241,12 +243,20 @@ def test_op_scan_ban_covers_new_subdirectories_zero_config(tmp_path):
         "import jax.numpy as jnp\n\n"
         "def scan(mask):\n"
         "    return jnp.nonzero(mask)[0]\n")
+    # ISSUE 19 regression: the fleet tier landed as a NEW directory —
+    # pin that the walk needs no config change for exactly that shape
+    # (a fresh package under an existing olap/ parent)
+    fleet = tmp_path / "titan_tpu" / "olap" / "fleet"
+    fleet.mkdir(parents=True)
+    (fleet / "router.py").write_text(
+        "import jax.numpy as jnp\n\n"
+        "def pick(mask):\n"
+        "    return jnp.nonzero(mask)[0]\n")
     result = Linter(root=str(tmp_path)).run(["titan_tpu"])
-    assert len(result.unsuppressed) == 1
-    f = result.unsuppressed[0]
-    assert f.rule == "opscan"
-    assert f.path == \
-        "titan_tpu/brand_new_subsystem/deeper/kernels.py"
+    assert len(result.unsuppressed) == 2
+    assert {(f.rule, f.path) for f in result.unsuppressed} == {
+        ("opscan", "titan_tpu/brand_new_subsystem/deeper/kernels.py"),
+        ("opscan", "titan_tpu/olap/fleet/router.py")}
 
 
 @pytest.mark.parametrize("seed", [3, 11])

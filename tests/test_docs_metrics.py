@@ -26,8 +26,11 @@ _DOC = os.path.join(_REPO, "docs", "monitoring.md")
 # expansion (device./flightrec. joined serving. in ISSUE 10;
 # controller./scan. in ISSUE 14 — the autotune decision plane and the
 # distributed-scan instrumentation; obs. in ISSUE 18 — span ingest +
-# metrics federation)
-_FAMILIES = r"(?:serving|device|flightrec|controller|scan|obs)"
+# metrics federation; fleet. in ISSUE 19 — the replica fleet tier,
+# whose metric names live under serving.fleet.* but whose family is
+# guarded on its own so a future top-level fleet.* name can't dodge
+# the doc tables)
+_FAMILIES = r"(?:serving|device|flightrec|controller|scan|obs|fleet)"
 _LITERAL = re.compile(
     r"""["']f?(""" + _FAMILIES
     + r"""\.[a-z0-9_]+\.[a-z0-9_.{}]+)["']""")
@@ -105,8 +108,20 @@ def test_every_code_metric_documented_and_vice_versa():
                    "controller.", "scan.remote.",
                    # ISSUE 18: cross-process span ingest + metrics
                    # federation
-                   "obs.ingest.", "obs.federate."):
+                   "obs.ingest.", "obs.federate.",
+                   # ISSUE 19: the replica fleet routing/failover tier
+                   "serving.fleet."):
         assert any(n.startswith(family) for n in code), (family, code)
+    # ISSUE 19: the fleet router's admission/failover evidence must
+    # stay in the scan (created in olap/fleet/router.py) — including
+    # the single-count admission counter the double-count regression
+    # test pins
+    for name in ("serving.fleet.routed",
+                 "serving.fleet.redispatches",
+                 "serving.fleet.redispatch_latency_ms",
+                 "serving.fleet.replicas_up",
+                 "serving.jobs.submitted"):
+        assert name in code, name
     # ISSUE 18: the cross-process observability surface must stay in
     # the scan (created in obs/tracing.ingest and obs/federate)
     for name in ("obs.ingest.spans", "obs.ingest.dropped",

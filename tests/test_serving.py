@@ -304,9 +304,12 @@ def test_malformed_jobs_never_kill_the_worker(metrics, snap_main):
 
 
 def test_batch_key_separates_incompatible_jobs():
-    """Only jobs that can share ONE fused level loop may batch: kind,
-    snapshot parameters AND max_levels must agree (a tight level cap
-    must not truncate batchmates, nor ride past its own)."""
+    """Only jobs that can share ONE fused round loop may batch: kind,
+    snapshot parameters AND the kind's cohort-wide knobs must agree (a
+    tight level cap must not truncate batchmates, nor ride past its
+    own). Since ISSUE 19 SSSP and WCC are batchable too — into
+    PER-ALGORITHM cohorts whose keys can never collide with another
+    kind's (the kind leads every key)."""
     from titan_tpu.olap.serving.batcher import batch_key
 
     base = batch_key(JobSpec(kind="bfs"))
@@ -316,7 +319,24 @@ def test_batch_key_separates_incompatible_jobs():
                              params={"max_levels": 3})) != base
     assert batch_key(JobSpec(kind="bfs", directed=True)) != base
     assert batch_key(JobSpec(kind="bfs", labels=("knows",))) != base
-    assert batch_key(JobSpec(kind="sssp")) is None
+    # sssp/wcc fuse among themselves, never with bfs or each other
+    sssp = batch_key(JobSpec(kind="sssp"))
+    wcc = batch_key(JobSpec(kind="wcc"))
+    assert sssp is not None and wcc is not None
+    assert len({base, sssp, wcc}) == 3
+    assert batch_key(JobSpec(kind="sssp")) == sssp
+    # SSSP mode knobs are cohort-wide: differing knobs must not fuse
+    assert batch_key(JobSpec(kind="sssp",
+                             params={"delta": 0.3})) != sssp
+    assert batch_key(JobSpec(kind="sssp",
+                             params={"quantile_mass": 64})) != sssp
+    assert batch_key(JobSpec(kind="sssp",
+                             params={"max_rounds": 7})) != sssp
+    # junk knob values: run (and fail) alone, never poison a cohort
+    assert batch_key(JobSpec(kind="sssp",
+                             params={"delta": "wat"})) is None
+    # pagerank stays single-execution
+    assert batch_key(JobSpec(kind="pagerank")) is None
 
 
 def test_hbm_ledger_eviction_and_pinning():
@@ -395,3 +415,130 @@ def test_computer_run_async_delegates_to_scheduler():
             sched.close()
     finally:
         g.close()
+
+
+# --------------------------------------------------------------------------
+# SSSP/WCC cohorts (ISSUE 19): bit-equality + per-algorithm fusion
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("K", [1, 4, 8])
+def test_batched_sssp_bit_equal_to_sequential(K, snap_main):
+    """Property: every member of a K-way SSSP cohort (shared round
+    loop, one stacked plan sync per round) equals the sequential run
+    from its source — distances AND round counts, duplicates
+    included."""
+    from titan_tpu.models.frontier import (frontier_sssp,
+                                           frontier_sssp_batched)
+
+    snap = snap_main
+    rng = np.random.default_rng(200 + K)
+    nz = np.flatnonzero(snap.out_degree > 0)
+    sources = [int(s) for s in rng.choice(nz, size=K, replace=True)]
+    outs, rounds, stopped = frontier_sssp_batched(snap, sources)
+    assert stopped == [None] * K
+    for k, s in enumerate(sources):
+        ref, ref_rounds = frontier_sssp(snap, s)
+        assert rounds[k] == ref_rounds, f"member {k} source {s}"
+        assert (np.asarray(outs[k]) == np.asarray(ref)).all(), \
+            f"member {k} source {s}"
+
+
+def test_batched_sssp_delta_mode_bit_equal(snap_main):
+    """Cohort-wide mode knobs (delta-stepping here) produce the same
+    per-member trajectory the sequential kernel walks under the same
+    knobs — the contract behind the batch key pinning them."""
+    from titan_tpu.models.frontier import (frontier_sssp,
+                                           frontier_sssp_batched)
+
+    snap = snap_main
+    nz = np.flatnonzero(snap.out_degree > 0)
+    sources = [int(s) for s in nz[:4]]
+    outs, rounds, _ = frontier_sssp_batched(snap, sources, delta=0.3)
+    for k, s in enumerate(sources):
+        ref, ref_rounds = frontier_sssp(snap, s, delta=0.3)
+        assert rounds[k] == ref_rounds
+        assert (np.asarray(outs[k]) == np.asarray(ref)).all()
+
+
+@pytest.mark.parametrize("K", [1, 4])
+def test_batched_wcc_bit_equal_to_sequential(K, snap_main):
+    from titan_tpu.models.frontier import (frontier_wcc,
+                                           frontier_wcc_batched)
+
+    snap = snap_main
+    ref, ref_rounds = frontier_wcc(snap)
+    outs, rounds, stopped = frontier_wcc_batched(snap, K)
+    assert stopped == [None] * K
+    for k in range(K):
+        assert rounds[k] == ref_rounds
+        assert (np.asarray(outs[k]) == np.asarray(ref)).all()
+
+
+def test_batched_sssp_mixed_early_exit(snap_main):
+    """A member vetoed mid-cohort (the serving layer's cancel/timeout
+    hook) drops at exactly that round — out None, stopped set — while
+    every survivor still finishes bit-equal to sequential."""
+    from titan_tpu.models.frontier import (frontier_sssp,
+                                           frontier_sssp_batched)
+
+    snap = snap_main
+    nz = np.flatnonzero(snap.out_degree > 0)
+    sources = [int(s) for s in nz[:4]]
+
+    def on_round(k, rounds):
+        return not (k == 1 and rounds >= 2)
+
+    outs, rounds, stopped = frontier_sssp_batched(
+        snap, sources, on_round=on_round)
+    assert outs[1] is None and stopped[1] == 2
+    for k in (0, 2, 3):
+        assert stopped[k] is None
+        ref, ref_rounds = frontier_sssp(snap, sources[k])
+        assert rounds[k] == ref_rounds
+        assert (np.asarray(outs[k]) == np.asarray(ref)).all()
+
+
+def test_scheduler_mixed_stream_fuses_per_algorithm(metrics, snap_main):
+    """A mixed BFS/SSSP/WCC submit stream fuses into PER-ALGORITHM
+    cohorts: each kind's fresh jobs share one batch (batch_k = that
+    kind's count), kinds never cross-fuse, and every result is
+    bit-equal to its sequential reference."""
+    from titan_tpu.models.bfs_hybrid import frontier_bfs_hybrid
+    from titan_tpu.models.frontier import frontier_sssp, frontier_wcc
+
+    snap = snap_main
+    nz = np.flatnonzero(snap.out_degree > 0)
+    sched = JobScheduler(snapshot=snap, metrics=metrics,
+                         autostart=False)
+    try:
+        bfs = [sched.submit(JobSpec(kind="bfs",
+                                    params={"source_dense": int(s)}))
+               for s in nz[:4]]
+        sssp = [sched.submit(JobSpec(kind="sssp",
+                                     params={"source_dense": int(s)}))
+                for s in nz[:4]]
+        wcc = [sched.submit(JobSpec(kind="wcc")) for _ in range(3)]
+        sched.start()
+        for job in bfs + sssp + wcc:
+            assert job.wait(120), job
+            assert job.state.value == "done", (job, job.error)
+        # per-algorithm fusion, never cross-kind: batch_k equals the
+        # kind's own cohort width exactly
+        assert [j.batch_k for j in bfs] == [4] * 4
+        assert [j.batch_k for j in sssp] == [4] * 4
+        assert [j.batch_k for j in wcc] == [3] * 3
+        for job in bfs:
+            ref, _ = frontier_bfs_hybrid(
+                snap, int(job.spec.params["source_dense"]))
+            assert (job.result["dist"] == np.asarray(ref)).all()
+        for job in sssp:
+            ref, ref_rounds = frontier_sssp(
+                snap, int(job.spec.params["source_dense"]))
+            assert job.result["rounds"] == ref_rounds
+            assert (job.result["dist"] == np.asarray(ref)).all()
+        wref, wrounds = frontier_wcc(snap)
+        for job in wcc:
+            assert job.result["rounds"] == wrounds
+            assert (job.result["labels"] == np.asarray(wref)).all()
+    finally:
+        sched.close()

@@ -569,6 +569,315 @@ def _frontier_run(snap_or_graph, val, val_exp, kind: str, wparams,
     return val[:n], rounds
 
 
+class _CohortMember:
+    """Host-side loop state for ONE member of a fused frontier cohort
+    (``frontier_sssp_batched`` / ``frontier_wcc_batched``): its own
+    device value arrays plus the scheduler-mode knobs the sequential
+    ``_frontier_run`` keeps in locals — so every per-round decision the
+    cohort driver makes for this member is computed from exactly the
+    state the member's solo run would have had."""
+
+    __slots__ = ("k", "val", "val_exp", "bucket_end", "quantile_mass",
+                 "prev_sig", "rounds", "out", "stopped")
+
+    def __init__(self, k: int, val, val_exp, bucket_end, quantile_mass):
+        self.k = k
+        self.val = val
+        self.val_exp = val_exp
+        self.bucket_end = bucket_end
+        self.quantile_mass = int(quantile_mass)
+        self.prev_sig = None
+        self.rounds = 0
+        self.out = None        # device [n] result once terminated
+        self.stopped = None    # on_round veto: the vetoed round number
+
+
+def _frontier_cohort(g, members, kind: str, wparams, max_rounds: int,
+                     delta: float = 0.0, on_round=None, checkpoint=None,
+                     overlay=None) -> None:
+    """Shared round loop over K per-member ``(val, val_exp)`` states —
+    the cohort generalization of ``_frontier_run``. Each round
+    dispatches every active member's band plan (the member's OWN static
+    args, so the SAME jit entries as a solo run) and reads all K stats
+    vectors back in ONE stacked host sync — the per-round plan-readback
+    floor (PERF_NOTES: 0.1-0.9s D2H through the tunnel) is paid once
+    per cohort round instead of once per member.
+
+    Bit-equality contract: every per-member decision — threshold mode,
+    segment count, kernel-width buckets, quantile->plain escalation,
+    delta bucket advance, repeated-signature escalation, termination —
+    is computed from that member's own stats with the sequential code's
+    exact expressions, and the pushes are order-independent min-
+    scatters, so each member's final arrays AND round count are
+    bit-equal to its solo ``_frontier_run``. Mode transitions that
+    re-plan without advancing the round (the sequential ``continue``
+    branches) are serviced solo for that member — an extra sync on the
+    rare transition round, never on the steady state.
+
+    ``on_round(k, rounds)`` / ``checkpoint(k, rounds, state)`` are the
+    per-member forms of the sequential hooks (same boundary ordering:
+    veto, then checkpoint, then the plan); a vetoed member records
+    ``stopped`` and simply leaves the cohort — the analog of
+    ``RoundInterrupted`` that cannot abandon its K-1 batchmates.
+    Fresh-start cohorts only: resumed jobs run solo through
+    ``frontier_sssp``/``frontier_wcc`` (their round counter differs
+    from any fresh batchmate — the same split the batched BFS makes)."""
+    import jax.numpy as jnp
+
+    n = g["n"]
+    dstT, colstart, degc = g["dstT"], g["colstart"], g["degc"]
+    plan = _band_plan(kind)
+    pushl = _push_list(kind)
+    ov = overlay
+    if ov is not None and ov.empty:
+        ov = None
+    masked = ov is not None and ov.tomb_count > 0
+    has_adds = ov is not None and ov.count > 0
+    relax = _overlay_relax(kind) if has_adds else None
+    max_dc = _max_degc(g)
+    is_f32 = members[0].val.dtype == jnp.float32
+    big = float(FINF) if is_f32 else int(IINF)
+    dtname = "float32" if is_f32 else "int32"
+    w_max = 1 << ((n + 1).bit_length() - 1)
+    target = _next_pow2(max(SLICE_BUDGET_CHUNKS, 2))
+    if max_dc <= target // 2:
+        budget = target - max_dc
+        p_full = target
+    else:
+        budget = SLICE_BUDGET_CHUNKS
+        p_full = _next_pow2(max(budget + max_dc, 2))
+    wp = jnp.asarray(np.asarray(wparams, np.float32))
+    tbits = ov.tomb_dev if masked else jnp.zeros((1,), jnp.uint8)
+
+    def _relax(v):
+        return relax(v, ov.src_dev, ov.dst_dev, wp,
+                     dev_scalar(ov.slot_base), cap=ov.cap, n_=n)
+
+    if has_adds:
+        # fresh start: seed the overlay's one-hop reach per member
+        # (cohorts are fresh-only — see the docstring)
+        for m in members:
+            m.val, _ = _relax(m.val)
+
+    def _boundary(m) -> bool:
+        """Round-boundary hooks in the sequential order (veto first,
+        then checkpoint); False = the member was vetoed out."""
+        if on_round is not None and not on_round(m.k, m.rounds):
+            m.stopped = m.rounds
+            return False
+        if checkpoint is not None:
+            checkpoint(m.k, m.rounds,
+                       {"val": m.val, "val_exp": m.val_exp,
+                        "bucket_end": m.bucket_end,
+                        "quantile_mass": m.quantile_mass})
+        return True
+
+    def _dispatch(m):
+        qf_cap = min(QUANT_LIST_CAP, w_max) if m.quantile_mass else w_max
+        be_dev = dev_scalar(m.bucket_end, dtname)
+        stats, flist, lbounds, thr_dev = plan(
+            m.val, m.val_exp, degc, be_dev, n_=n, f_cap=qf_cap,
+            k_max=SLICE_K_MAX, budget=budget,
+            quantile_mass=m.quantile_mass)
+        return qf_cap, stats, flist, lbounds, thr_dev
+
+    def _host_step(m, st_h, qf_cap, flist, lbounds, thr_dev) -> str:
+        """One member's host-side round logic over its synced stats —
+        'done' | 'advanced' | 'replan' (the sequential ``continue``)."""
+        nf, m8 = int(st_h[0]), int(st_h[1])
+        if int(st_h[2]):
+            raise RuntimeError(
+                "banded_frontier: listed chunk mass overflowed int32 — "
+                "segment bounds are corrupt (enable JAX x64 or shard "
+                "the graph below 2^31 chunks)")
+        pmin = st_h[3:4].view(np.float32)[0] if is_f32 else st_h[3]
+        if nf == 0 or m8 == 0:
+            if has_adds:
+                m.val, nimp = _relax(m.val)
+                if int(np.asarray(nimp)) > 0:
+                    m.rounds += 1
+                    return "advanced"
+            if float(pmin) >= big * (1 - 1e-6):
+                m.out = m.val[:n]
+                return "done"
+            if m.quantile_mass:
+                m.quantile_mass = 0
+                return "replan"
+            if delta and delta > 0:
+                m.bucket_end = float(
+                    (np.floor(float(pmin) / delta) + 1) * delta)
+                return "replan"
+            raise RuntimeError(
+                f"frontier_{kind}: empty round with pending work "
+                f"(pmin={pmin!r}) in plain mode")
+        sig = (nf, m8, float(pmin), float(m.bucket_end), m.quantile_mass)
+        escalate = sig == m.prev_sig
+        m.prev_sig = sig
+        nseg = min(-(-m8 // budget), SLICE_K_MAX)
+        f_bucket = _quantize_cap(min(nf, budget + max_dc), qf_cap)
+        for k in range(nseg):
+            mass_k = min(budget, m8 - k * budget) + max_dc
+            p_cap = p_full if escalate else _quantize_cap(mass_k, p_full)
+            fk = min(qf_cap, p_full) if escalate \
+                else min(f_bucket, p_cap)
+            m.val, m.val_exp = pushl(
+                m.val, m.val_exp, flist, lbounds, dev_scalar(k),
+                thr_dev, dstT, colstart, degc, wp, tbits,
+                f_cap=fk, p_cap=p_cap, n_=n, masked=masked)
+        if has_adds:
+            m.val, _ = _relax(m.val)
+        m.rounds += 1
+        return "advanced"
+
+    def _solo(m) -> None:
+        """Drain a member's re-plan rounds alone (its mode knobs just
+        changed; the cohort's shared sync has already happened)."""
+        while m.out is None and m.stopped is None \
+                and m.rounds < max_rounds:
+            if not _boundary(m):
+                return
+            qf_cap, stats, flist, lbounds, thr_dev = _dispatch(m)
+            st_h = np.asarray(stats)
+            if _host_step(m, st_h, qf_cap, flist, lbounds,
+                          thr_dev) != "replan":
+                return
+        if m.out is None and m.stopped is None:
+            m.out = m.val[:n]            # max_rounds exhausted
+
+    active = list(members)
+    while True:
+        for m in active:
+            if m.rounds >= max_rounds and m.out is None \
+                    and m.stopped is None:
+                m.out = m.val[:n]
+        active = [m for m in active
+                  if m.out is None and m.stopped is None]
+        if not active:
+            return
+        ready = []
+        for m in active:
+            if _boundary(m):
+                ready.append((m, _dispatch(m)))
+        if not ready:
+            continue
+        # THE amortization: K members' round plans in one stacked sync
+        st_all = np.asarray(jnp.stack([d[1] for _m, d in ready]))
+        replans = []
+        for (m, (qf_cap, _stats, flist, lbounds, thr_dev)), st_h \
+                in zip(ready, st_all):
+            if _host_step(m, st_h, qf_cap, flist, lbounds,
+                          thr_dev) == "replan":
+                replans.append(m)
+        for m in replans:
+            _solo(m)
+
+
+def frontier_sssp_batched(snap_or_graph, sources, min_w: float = 0.0,
+                          w_range: float = 1.0, max_rounds: int = 10_000,
+                          delta: float | None = None,
+                          quantile_mass: int | None = None,
+                          on_round=None, checkpoint=None,
+                          return_device: bool = False, overlay=None):
+    """K-source SSSP cohort over one shared round loop
+    (``_frontier_cohort``): per-member device state, ONE stacked plan
+    readback per round. Each member's distances and round count are
+    bit-equal to ``frontier_sssp(source=sources[k])`` with the same
+    knobs — the mode knobs (``delta``/``quantile_mass``/``max_rounds``)
+    are cohort-wide, which is why the serving batch key pins them.
+
+    ``on_round(k, rounds)``: per-member veto — a False drops member
+    ``k`` from the cohort (``stopped[k]`` records the round) without
+    touching its batchmates. ``checkpoint(k, rounds, state)``: the
+    sequential state dict per member. Returns ``(dists, rounds,
+    stopped)`` lists of length K; a vetoed member's dist is None."""
+    import jax.numpy as jnp
+
+    g = snap_or_graph if isinstance(snap_or_graph, dict) \
+        else build_chunked_csr(snap_or_graph)
+    n = g["n"]
+    if delta is None:
+        delta = 0.0
+    if quantile_mass is None:
+        quantile_mass = 0 if delta and delta > 0 \
+            else QUANTILE_MASS_DEFAULT
+    if overlay is None and not isinstance(snap_or_graph, dict):
+        overlay = getattr(snap_or_graph, "_live_overlay", None)
+    bucket0 = float(FINF) if not delta or delta <= 0 else float(delta)
+    members = []
+    for k, s in enumerate(sources):
+        val = jnp.full((n + 1,), FINF, jnp.float32) \
+            .at[int(s)].set(0.0)
+        val_exp = jnp.full((n + 1,), FINF, jnp.float32)
+        members.append(_CohortMember(k, val, val_exp, bucket0,
+                                     int(quantile_mass)))
+    _frontier_cohort(g, members, "sssp", (min_w, w_range), max_rounds,
+                     delta=float(delta), on_round=on_round,
+                     checkpoint=checkpoint, overlay=overlay)
+    outs = [m.out if return_device or m.out is None
+            else np.asarray(m.out) for m in members]
+    return outs, [m.rounds for m in members], \
+        [m.stopped for m in members]
+
+
+def frontier_wcc_batched(snap_or_graph, count: int,
+                         max_rounds: int = 10_000, on_round=None,
+                         checkpoint=None, return_device: bool = False,
+                         overlay=None):
+    """K-member WCC cohort. WCC has no per-job source, so the BFS peel
+    and seed labels are computed ONCE and copied per member; members
+    then differ only in their serving-layer hooks (per-job veto,
+    checkpoint cadence, fault injection) while sharing the round loop's
+    single stacked plan sync. Each member's labels and round count are
+    bit-equal to a solo ``frontier_wcc``. ``checkpoint(k, rounds,
+    state)`` states carry ``levels`` like the sequential form. Returns
+    ``(labels, rounds, stopped)`` with rounds including the shared BFS
+    peel's level count."""
+    import jax.numpy as jnp
+
+    g = snap_or_graph if isinstance(snap_or_graph, dict) \
+        else build_chunked_csr(snap_or_graph)
+    if overlay is None and not isinstance(snap_or_graph, dict):
+        overlay = getattr(snap_or_graph, "_live_overlay", None)
+    if overlay is not None and overlay.empty:
+        overlay = None
+    n = g["n"]
+    if n == 0:
+        z = jnp.zeros((0,), jnp.int32)
+        out = z if return_device else np.asarray(z)
+        return [out] * count, [0] * count, [None] * count
+    if overlay is not None:
+        # no BFS peel over a live overlay (same fallback as the
+        # sequential path): pure min-label propagation from own ids
+        ids = jnp.arange(n, dtype=jnp.int32)
+        val0 = jnp.concatenate([ids, jnp.full((1,), IINF, jnp.int32)])
+        exp0 = jnp.concatenate(
+            [ids + 1, jnp.full((1,), IINF, jnp.int32)])
+        levels = 0
+    else:
+        seed_v = int(np.asarray(jnp.argmax(g["deg"][:n])))
+        dist, levels = frontier_bfs_hybrid(g, seed_v, max_levels=n,
+                                           return_device=True)
+        val0, exp0 = _wcc_seed_labels()(dist, n_=n)
+    ck = None
+    if checkpoint is not None:
+        def ck(k, rounds, state, _levels=levels):
+            state = dict(state)
+            state["levels"] = _levels
+            checkpoint(k, rounds, state)
+    # per-member COPIES: _push_list donates its value buffers, so two
+    # members must never alias one device array
+    members = [_CohortMember(k, jnp.array(val0, copy=True),
+                             jnp.array(exp0, copy=True),
+                             int(IINF), 0)
+               for k in range(count)]
+    _frontier_cohort(g, members, "wcc", (0.0, 0.0), max_rounds,
+                     on_round=on_round, checkpoint=ck, overlay=overlay)
+    outs = [m.out if return_device or m.out is None
+            else np.asarray(m.out) for m in members]
+    return outs, [m.rounds + levels for m in members], \
+        [m.stopped for m in members]
+
+
 def frontier_sssp(snap_or_graph, source_dense: int, min_w: float = 0.0,
                   w_range: float = 1.0, max_rounds: int = 10_000,
                   delta: float | None = None,

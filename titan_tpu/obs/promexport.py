@@ -94,6 +94,16 @@ HELP = {
         "decision-journal entries dropped past the bound",
     "controller.knob.value":
         "current autotuned knob value, by knob",
+    "serving.fleet.routed":
+        "jobs and traversals dispatched by the fleet router, by "
+        "replica instance",
+    "serving.fleet.redispatches":
+        "in-flight jobs re-dispatched to a survivor after their "
+        "replica died (idempotent failover)",
+    "serving.fleet.redispatch_latency_ms":
+        "death-detection to survivor-accept wall time per failover",
+    "serving.fleet.replicas_up":
+        "replicas currently routable (healthy and un-evicted)",
     "scan.remote.splits_dispatched":
         "scan splits shipped to HTTP scan workers",
     "scan.remote.splits_merged":

@@ -285,7 +285,14 @@ class JobSpec:
     queue-ms / device-seconds / HBM-byte-seconds / replayed-rounds to a
     named tenant, labels its metrics and trace, and subjects it to that
     tenant's quota when the scheduler enforces quotas; unset/empty
-    falls back to ``"default"`` everywhere."""
+    falls back to ``"default"`` everywhere.
+
+    Fleet failover (olap/fleet): ``idempotency_key`` names the LOGICAL
+    job across processes — schedulers key this job's checkpoints by it
+    (instead of the per-scheduler private namespace), so a redispatch
+    of the same logical job onto a surviving replica adopts the dead
+    replica's newest checkpoint over the shared store and resumes
+    rather than restarts, on its FIRST local attempt."""
 
     kind: str
     params: dict = field(default_factory=dict)
@@ -299,6 +306,7 @@ class JobSpec:
     checkpoint_every: int = 0
     retry_backoff_s: float = 0.05
     tenant: Optional[str] = None
+    idempotency_key: Optional[str] = None
 
 
 class DenseProgram(abc.ABC):

@@ -71,6 +71,7 @@ KNOB_K = "batcher.target_k"
 KNOB_SCALE = "tenant.quota_scale"
 KNOB_COMPACT = "live.compact"
 KNOB_CKPT = "recovery.checkpoint_every"
+KNOB_FLEET = "fleet.routing_weight"
 
 #: rule parameter defaults. Every decision records the EFFECTIVE params
 #: it was evaluated under, so a journaled entry replays bit-equal even
@@ -97,6 +98,13 @@ DEFAULT_PARAMS = {
     "ckpt_min_every": 1,
     "ckpt_max_every": 64,
     "ckpt_cooldown_s": 30.0,
+    # fleet routing-weight rule (olap/fleet: the router's controller
+    # feeds a "fleet" signal block; the scheduler-side controller never
+    # produces one, so this rule is inert there)
+    "fleet_spread_high": 1.0,   # (max-min)/mean depth that biases harder
+    "fleet_spread_low": 0.25,   # spread under which the bias decays back
+    "fleet_weight_cap": 8.0,
+    "fleet_cooldown_s": 5.0,
 }
 
 DEFAULT_TICK_S = 1.0
@@ -238,6 +246,41 @@ def _rule_ckpt(sig: dict, knobs: dict, p: dict) -> list:
                      f"every {every}")}]
 
 
+def _rule_fleet(sig: dict, knobs: dict, p: dict) -> list:
+    """Fleet routing-weight rule (olap/fleet, ISSUE 19): the router's
+    controller injects a ``fleet`` signal block — per-replica in-flight
+    ``depth_spread`` ((max-min)/mean). A wide spread means the weighted
+    pick is not steering hard enough toward idle replicas: double the
+    ``depth`` weight (capped); a collapsed spread decays it back toward
+    the neutral 1.0. Scheduler-side controllers never collect a
+    ``fleet`` block, so the rule is inert there by construction."""
+    fl = sig.get("fleet")
+    if not fl:
+        return []
+    spread = fl.get("depth_spread")
+    if spread is None:
+        return []
+    spread = float(spread)
+    weights = knobs.get("fleet_weights") or {}
+    w = float(weights.get("depth", 1.0))
+    if spread >= p["fleet_spread_high"] and w < p["fleet_weight_cap"]:
+        new = min(float(p["fleet_weight_cap"]), w * 2)
+        return [{"rule": "fleet.rebalance",
+                 "knob": f"{KNOB_FLEET}.depth", "old": w, "new": new,
+                 "signal": "depth",
+                 "why": (f"in-flight depth spread {spread:.2f} >= "
+                         f"{p['fleet_spread_high']:.2f}: bias routing "
+                         f"harder toward idle replicas")}]
+    if spread <= p["fleet_spread_low"] and w > 1.0:
+        return [{"rule": "fleet.relax",
+                 "knob": f"{KNOB_FLEET}.depth", "old": w,
+                 "new": max(1.0, w / 2), "signal": "depth",
+                 "why": (f"depth spread {spread:.2f} <= "
+                         f"{p['fleet_spread_low']:.2f}: decay the "
+                         f"routing bias back toward neutral")}]
+    return []
+
+
 #: rule id prefix → (evaluator, cooldown param) — tick and replay
 #: dispatch through this one table
 _RULES = (
@@ -245,6 +288,7 @@ _RULES = (
     (_rule_tenant, "shed_cooldown_s"),
     (_rule_compact, "compact_cooldown_s"),
     (_rule_ckpt, "ckpt_cooldown_s"),
+    (_rule_fleet, "fleet_cooldown_s"),
 )
 
 
@@ -310,6 +354,10 @@ class Controller:
                             if scheduler is not None else 16)
         self.scales: dict[str, float] = {}
         self.checkpoint_every = 0
+        # fleet routing-weight multipliers (signal name → weight); only
+        # populated on a router-owned controller whose signal source
+        # injects a "fleet" block — read back via routing_weights()
+        self.fleet_weights: dict[str, float] = {}
         self.ticks = 0
         self._cooldowns: dict[str, float] = {}
         self._journal: list[dict] = []
@@ -440,7 +488,8 @@ class Controller:
         # reconstruct candidate selection (scales) and diffs (old K)
         sig["knobs"] = {"target_k": self.target_k,
                         "scales": dict(self.scales),
-                        "checkpoint_every": self.checkpoint_every}
+                        "checkpoint_every": self.checkpoint_every,
+                        "fleet_weights": dict(self.fleet_weights)}
         return sig
 
     # -- tick ----------------------------------------------------------------
@@ -476,7 +525,9 @@ class Controller:
                 # journaled snapshot must be self-contained
                 sig["knobs"] = {"target_k": self.target_k,
                                 "scales": dict(self.scales),
-                                "checkpoint_every": self.checkpoint_every}
+                                "checkpoint_every": self.checkpoint_every,
+                                "fleet_weights": dict(
+                                    self.fleet_weights)}
             knobs = sig["knobs"]
             entries = []
             for prop in evaluate(sig, knobs, self.params):
@@ -521,6 +572,12 @@ class Controller:
                 self.scales[t] = float(prop["new"])
         elif rule == "recovery.cadence":
             self.checkpoint_every = int(prop["new"])
+        elif rule.startswith("fleet."):
+            s = prop["signal"]
+            if prop["new"] <= 1.0:
+                self.fleet_weights.pop(s, None)
+            else:
+                self.fleet_weights[s] = float(prop["new"])
         self._journal.append(entry)
         if len(self._journal) > self.journal_cap:
             del self._journal[0]
@@ -583,6 +640,16 @@ class Controller:
             max_device_seconds=quota.max_device_seconds * s
             if quota.max_device_seconds is not None else None)
 
+    def routing_weights(self) -> dict:
+        """Fleet routing-weight multipliers for the olap/fleet router's
+        weighted pick (signal name → weight; absent = 1.0). Empty
+        outside enforce mode — shadow journals the trajectory, the
+        router must keep routing neutrally."""
+        if self.mode != "enforce":
+            return {}
+        with self._lock:
+            return dict(self.fleet_weights)
+
     def checkpoint_every_hint(self) -> int:
         """The adaptive default cadence for retryable jobs that did not
         set their own ``checkpoint_every`` — 0 (no hint) outside
@@ -609,9 +676,10 @@ class Controller:
         if self.mode == "enforce" or self.scheduler is None:
             return {KNOB_K: self.target_k,
                     KNOB_SCALE: dict(self.scales),
-                    KNOB_CKPT: self.checkpoint_every}
+                    KNOB_CKPT: self.checkpoint_every,
+                    KNOB_FLEET: dict(self.fleet_weights)}
         return {KNOB_K: self.scheduler.max_batch,
-                KNOB_SCALE: {}, KNOB_CKPT: 0}
+                KNOB_SCALE: {}, KNOB_CKPT: 0, KNOB_FLEET: {}}
 
     def state(self) -> dict:
         """The ``GET /controller`` envelope + the flight-recorder
@@ -632,5 +700,6 @@ class Controller:
                 out["shadow_knobs"] = {
                     KNOB_K: self.target_k,
                     KNOB_SCALE: dict(self.scales),
-                    KNOB_CKPT: self.checkpoint_every}
+                    KNOB_CKPT: self.checkpoint_every,
+                    KNOB_FLEET: dict(self.fleet_weights)}
             return out

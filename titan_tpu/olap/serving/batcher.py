@@ -28,25 +28,44 @@ import numpy as np
 from titan_tpu.olap.serving.jobs import Job
 
 #: jobs of these kinds fuse into one batched run when they share a
-#: snapshot (the only batchable kind today; SSSP banding is next)
-BATCHABLE_KINDS = ("bfs",)
+#: snapshot — BFS through the [K, n] batched kernel, SSSP/WCC through
+#: the per-member cohort driver (models/frontier._frontier_cohort)
+BATCHABLE_KINDS = ("bfs", "sssp", "wcc")
+
+#: kinds the mesh placement path understands (parallel/partition
+#: places the BATCHED BFS layout only) — the would_mesh predicate and
+#: the scheduler's per-device ledger accounting key off this, NOT off
+#: BATCHABLE_KINDS, so adding cohort kinds cannot silently change what
+#: the admission guard charges per device
+_MESH_KINDS = ("bfs",)
 
 
 def batch_key(spec) -> Optional[tuple]:
-    """Grouping key: jobs with equal keys may fuse into one batch.
-    ``max_levels`` is part of the key — the batched kernel runs ONE
-    shared level loop, so a job with a tighter level cap must not drag
-    batchmates down to it (nor ride past its own)."""
+    """Grouping key: jobs with equal keys may fuse into one batch. The
+    kind is always in the key (a mixed stream fuses into PER-ALGORITHM
+    cohorts, never across kinds), plus every knob the fused run shares:
+    ``max_levels`` for BFS (one shared level loop), the scheduler-mode
+    knobs ``max_rounds``/``delta``/``quantile_mass`` for SSSP (the
+    cohort runs each member's trajectory under cohort-wide mode knobs,
+    so differing knobs must not fuse)."""
     if spec.kind not in BATCHABLE_KINDS:
         return None
-    try:
-        max_levels = int(spec.params.get("max_levels", 1000))
-    except (TypeError, ValueError):
-        return None      # junk max_levels: run (and fail) alone
-    return (spec.kind,
+    base = (spec.kind,
             tuple(spec.labels) if spec.labels is not None else None,
-            bool(spec.directed),
-            max_levels)
+            bool(spec.directed))
+    try:
+        if spec.kind == "bfs":
+            return base + (int(spec.params.get("max_levels", 1000)),)
+        if spec.kind == "sssp":
+            delta = spec.params.get("delta")
+            qm = spec.params.get("quantile_mass")
+            return base + (
+                int(spec.params.get("max_rounds", 10_000)),
+                float(delta) if delta is not None else None,
+                int(qm) if qm is not None else None)
+        return base          # wcc: no per-job kernel knobs
+    except (TypeError, ValueError):
+        return None      # junk knob values: run (and fail) alone
 
 
 def _dense_source(snap, params: dict) -> int:
@@ -127,8 +146,23 @@ class Batcher:
         would over-commit real device HBM past the admission guard)."""
         return (self.mesh is not None
                 and int(self.mesh.devices.size) > 1
-                and kind in BATCHABLE_KINDS
+                and kind in _MESH_KINDS
                 and (overlay is None or overlay.empty))
+
+    def run_batch(self, jobs: list[Job], snap, overlay=None) -> None:
+        """Kind-generic batch entry (the scheduler's one dispatch
+        point): BFS groups go through the [K, n] batched kernel,
+        SSSP/WCC groups through the frontier cohort driver. The
+        scheduler's grouping key always carries the kind, so a group
+        is single-kind by construction."""
+        kind = jobs[0].spec.kind
+        if kind == "bfs":
+            self.run_bfs_batch(jobs, snap, overlay=overlay)
+        elif kind in ("sssp", "wcc"):
+            self.run_frontier_batch(jobs, snap, overlay=overlay)
+        else:
+            for job in jobs:
+                self.run_single(job, snap, overlay=overlay)
 
     # -- batched BFS --------------------------------------------------------
 
@@ -163,12 +197,19 @@ class Batcher:
                 continue
             ck = None
             rec = job.recovery
-            if rec is not None and job.attempt > 1:
+            # adoption: any retry attempt, OR a FIRST attempt carrying
+            # an idempotency key (fleet failover redispatch — the
+            # logical job already ran elsewhere and its checkpoints
+            # share the key, so attempt 1 here must resume, not
+            # restart; a keyed first run with no checkpoint is simply
+            # fresh, never counted restarted)
+            if rec is not None and (job.attempt > 1
+                                    or job.spec.idempotency_key):
                 ck = rec.latest(kind="bfs",
                                 epoch=_epoch_token(snap, overlay))
                 if ck is not None:
                     rec.resumed(ck.round)
-                else:
+                elif job.attempt > 1:
                     rec.restarted()
             if ck is not None:
                 resumed.append((job, src, ck))
@@ -300,6 +341,169 @@ class Batcher:
             else:
                 job.mark_cancelled()
 
+    # -- batched SSSP / WCC cohorts -----------------------------------------
+
+    def run_frontier_batch(self, jobs: list[Job], snap,
+                           overlay=None) -> None:
+        """Execute a same-kind group of SSSP or WCC jobs as one fused
+        cohort (models/frontier.frontier_sssp_batched /
+        frontier_wcc_batched): per-member device state under ONE shared
+        round loop with a single stacked plan readback per round, each
+        member bit-equal to its sequential run. Fresh first attempts
+        fuse; retry attempts and idempotency-keyed redispatches run
+        SOLO through ``run_single`` (their adoption bookkeeping and —
+        when a checkpoint matches — a round counter no fresh batchmate
+        shares; the same split the batched BFS makes for resumes)."""
+        t_fuse0 = time.time()
+        kind = jobs[0].spec.kind
+        fresh: list[Job] = []
+        fresh_src: list[int] = []
+        solo: list[Job] = []
+        for job in jobs:
+            src = 0
+            if kind == "sssp":
+                try:
+                    src = _dense_source(snap, job.spec.params)
+                except (KeyError, ValueError, TypeError) as e:
+                    job.fail(f"{type(e).__name__}: {e}", permanent=True)
+                    continue
+            rec = job.recovery
+            if rec is not None and (job.attempt > 1
+                                    or job.spec.idempotency_key):
+                solo.append(job)
+            else:
+                fresh.append(job)
+                fresh_src.append(src)
+        t_fuse1 = time.time()
+        for job in fresh:
+            if job.trace is not None:
+                job.trace.event("fuse", t0=t_fuse0, t1=t_fuse1,
+                                k=len(fresh), kind=kind,
+                                shared_plan=len(fresh) > 1)
+        for job in solo:
+            if job.trace is not None:
+                job.trace.event("fuse", t0=t_fuse0, t1=t_fuse1, k=1,
+                                kind=kind, shared_plan=False,
+                                solo="retry/redispatch attempt: may "
+                                     "resume from a checkpoint")
+        if fresh:
+            self._frontier_group(fresh, fresh_src, snap,
+                                 overlay=overlay)
+        for job in solo:
+            self.run_single(job, snap, overlay=overlay)
+
+    def _frontier_group(self, runnable: list[Job], sources: list[int],
+                        snap, overlay=None) -> None:
+        from titan_tpu.models.frontier import (FINF,
+                                               frontier_sssp_batched,
+                                               frontier_wcc_batched)
+
+        kind = runnable[0].spec.kind
+        K = len(runnable)
+        for job in runnable:
+            job.batch_k = K
+        started = time.time()
+        dropped = [None] * K    # terminal state decided at a boundary
+        runs = [job.trace.start("run", kind=kind, k=K,
+                                **({"overlay_edges": overlay.count,
+                                    "overlay_tombs": overlay.tomb_count}
+                                   if overlay is not None
+                                   and not overlay.empty else {}))
+                if job.trace is not None else None
+                for job in runnable]
+        # per-member round-window anchors, after the run spans open
+        prev_t = [time.time()] * K
+
+        def on_round(k, rounds):
+            job = runnable[k]
+            now = time.time()
+            if job.trace is not None:
+                job.trace.event("round", parent=runs[k],
+                                t0=prev_t[k], t1=now, round=rounds)
+                prev_t[k] = now
+            job.last_round = rounds
+            rec = job.recovery
+            if rec is not None and rec.faults is not None:
+                # raising here kills the WHOLE cohort — that is what a
+                # real worker death does, same as the batched BFS; each
+                # member then retries under its own policy
+                rec.faults.check(rounds, job.attempt, snap)
+            if job.cancel_requested:
+                dropped[k] = "cancel"
+                return False
+            if job.spec.timeout_s is not None and \
+                    now - started > job.spec.timeout_s:
+                dropped[k] = "timeout"
+                return False
+            return True
+
+        token = _epoch_token(snap, overlay)
+
+        def ckpt(k, rounds, state):
+            rec = runnable[k].recovery
+            if rec is None or rec.store is None or not rec.due(rounds):
+                return
+            arrays = {"val": np.asarray(state["val"]),
+                      "val_exp": np.asarray(state["val_exp"])}
+            if kind == "sssp":
+                rec.save(rounds, arrays, kind="sssp",
+                         meta={"epoch": token,
+                               "bucket_end": float(state["bucket_end"]),
+                               "quantile_mass":
+                                   int(state["quantile_mass"])})
+            else:
+                rec.save(rounds, arrays, kind="wcc",
+                         meta={"epoch": token,
+                               "levels": int(state["levels"])})
+
+        wants_ckpt = any(j.recovery is not None
+                         and j.recovery.store is not None
+                         for j in runnable)
+        params0 = runnable[0].spec.params
+        try:
+            if kind == "sssp":
+                outs, rounds_l, stopped = frontier_sssp_batched(
+                    snap, sources,
+                    delta=params0.get("delta"),
+                    quantile_mass=params0.get("quantile_mass"),
+                    max_rounds=int(params0.get("max_rounds", 10_000)),
+                    on_round=on_round,
+                    checkpoint=ckpt if wants_ckpt else None,
+                    overlay=overlay)
+            else:
+                outs, rounds_l, stopped = frontier_wcc_batched(
+                    snap, K, on_round=on_round,
+                    checkpoint=ckpt if wants_ckpt else None,
+                    overlay=overlay)
+        except Exception as e:
+            for i, job in enumerate(runnable):
+                if job.trace is not None:
+                    job.trace.end(runs[i], error=f"{type(e).__name__}")
+                job.fail(f"{type(e).__name__}: {e}")
+            return
+        from titan_tpu.obs import devprof
+        for i, job in enumerate(runnable):
+            if job.trace is not None:
+                job.trace.end(runs[i], rounds=int(rounds_l[i]))
+            if stopped[i] is not None:
+                if dropped[i] == "timeout":
+                    job.time_out()
+                else:
+                    job.mark_cancelled()
+                continue
+            arr = outs[i]
+            devprof.count_d2h("frontier.result",
+                              getattr(arr, "nbytes", 0))
+            if kind == "sssp":
+                job.complete({"rounds": int(rounds_l[i]),
+                              "reached":
+                                  int((arr < float(FINF)).sum()),
+                              "dist": arr})
+            else:
+                job.complete({"rounds": int(rounds_l[i]),
+                              "components": int(len(np.unique(arr))),
+                              "labels": arr})
+
     # -- single execution ---------------------------------------------------
 
     def run_single(self, job: Job, snap, overlay=None) -> None:
@@ -374,11 +578,17 @@ class Batcher:
             return True
         epoch = _epoch_token(snap, overlay)
         ck = None
-        if rec is not None and job.attempt > 1 and kind != "callable":
+        # adoption: any retry attempt, OR a first attempt under an
+        # idempotency key (fleet failover redispatch: the checkpoint
+        # store is shared and keyed, so attempt 1 here resumes the
+        # logical job's newest checkpoint instead of restarting; keyed
+        # first runs with no checkpoint are fresh, never "restarted")
+        if rec is not None and kind != "callable" \
+                and (job.attempt > 1 or job.spec.idempotency_key):
             ck = rec.latest(kind=kind, epoch=epoch)
             if ck is not None:
                 rec.resumed(ck.round)
-            else:
+            elif job.attempt > 1:
                 rec.restarted()
         wants_ckpt = rec is not None and rec.store is not None
 

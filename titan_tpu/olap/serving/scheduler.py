@@ -478,15 +478,23 @@ class JobScheduler:
                 and self.controller is not None:
             every = self.controller.checkpoint_every_hint()
         store = self.ckpt_store \
-            if self.ckpt_store is not None and every > 0 \
+            if self.ckpt_store is not None \
+            and (every > 0 or spec.idempotency_key) \
             else None
         if store is not None or faults is not None:
             from titan_tpu.olap.recovery import JobRecovery
+            # fleet failover: an idempotency key names the LOGICAL job
+            # across processes, so its checkpoints bypass the
+            # per-scheduler nonce namespace — a redispatch of the same
+            # key on another replica finds them and resumes
+            key = None
+            if store is not None:
+                key = f"idem-{spec.idempotency_key}" \
+                    if spec.idempotency_key \
+                    else f"{self._ckpt_ns}-{job.id}"
             job.recovery = JobRecovery(
                 store, job, every=every, faults=faults,
-                metrics=self._metrics,
-                key=f"{self._ckpt_ns}-{job.id}" if store is not None
-                else None)
+                metrics=self._metrics, key=key)
         if spec.deadline is not None and time.time() > spec.deadline:
             # tenant admission was already reserved by tenants.admit
             self._metrics.counter(
@@ -1025,8 +1033,8 @@ class JobScheduler:
                 else None
             try:
                 if len(group) > 1 or batch_key(spec) is not None:
-                    self.batcher.run_bfs_batch(group, snap,
-                                               overlay=overlay)
+                    self.batcher.run_batch(group, snap,
+                                           overlay=overlay)
                 else:
                     self.batcher.run_single(group[0], snap,
                                             overlay=overlay)

@@ -132,6 +132,13 @@ Endpoints:
                       entry also carries a ``trace`` digest
                       (queue_ms / fuse_ms / device_ms / rounds).
                       docs/observability.md documents the span model.
+  GET /trace/export?job=<id> — drain the trace's COMPLETED spans
+                      exactly once as wire dicts, framed with
+                      t_recv/t_send anchors (docs/fleet.md: the
+                      FleetRouter polls this on each replica and
+                      splices the spans into its own stitched tree
+                      via Tracer.ingest — including a dead replica's
+                      partial spans next to the redispatch span)
 
 Server config is a YAML file (gremlin-server.yaml analog):
   host: 127.0.0.1
@@ -345,7 +352,10 @@ class GraphServer:
                        max_retries=int(body.get("max_retries", 0)),
                        checkpoint_every=int(
                            body.get("checkpoint_every", 0)),
-                       tenant=body.get("tenant"))
+                       tenant=body.get("tenant"),
+                       idempotency_key=(
+                           str(body["idempotency_key"])
+                           if body.get("idempotency_key") else None))
         return self.scheduler().submit(spec)
 
     # -- interactive point-query lane (olap/serving/interactive) -------------
@@ -568,6 +578,30 @@ class GraphServer:
                         fed.scrape()
                         self._send(200, {"enabled": True,
                                          **fed.fleet()})
+                elif self.path.split("?", 1)[0] == "/trace/export":
+                    # fleet trace splice (olap/fleet): pop this trace's
+                    # COMPLETED spans exactly once, framed with local
+                    # receive/send anchors so the router's Tracer.ingest
+                    # can NTP-normalize remote clocks — the worker side
+                    # of the scan_worker /trace/drain idiom, for jobs
+                    import time as _time
+                    from urllib.parse import parse_qs, urlparse
+                    q = parse_qs(urlparse(self.path).query)
+                    tid = (q.get("job") or [None])[0]
+                    if tid is None:
+                        self._send(400, {"error": "trace/export needs "
+                                                  "?job=<id>",
+                                         "type": "BadRequest",
+                                         "retryable": False})
+                        return
+                    t_recv = _time.time()
+                    tracer = server.tracer()
+                    spans, dropped = tracer.drain(tid) \
+                        if tracer is not None else ([], 0)
+                    self._send(200, {"trace": tid, "spans": spans,
+                                     "dropped": dropped,
+                                     "t_recv": t_recv,
+                                     "t_send": _time.time()})
                 elif self.path.split("?", 1)[0] == "/trace":
                     from urllib.parse import parse_qs, urlparse
                     q = parse_qs(urlparse(self.path).query)

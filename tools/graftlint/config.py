@@ -43,7 +43,7 @@ DEFAULT_CONFIG: dict = {
     "metric-name": {
         "scope": ["titan_tpu/"],
         "families": ["serving", "device", "flightrec", "controller",
-                     "scan", "obs"],
+                     "scan", "obs", "fleet"],
         "doc": "docs/monitoring.md",
     },
     # R5 — modules that declare an injectable clock seam (a `clock`
